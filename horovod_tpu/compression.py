@@ -1,0 +1,84 @@
+"""Gradient compression applied around allreduce.
+
+Reference: horovod/torch/compression.py:20-74 (same classes duplicated per
+framework) — ``Compression.none`` and ``Compression.fp16``, where fp16
+compresses to half precision on the wire and decompresses back.
+
+On TPU the natural wire dtype is **bfloat16** (same 8-bit exponent as f32 — no
+range loss, which is why TPU hardware prefers it), so this build adds
+``Compression.bf16`` and makes ``fp16`` keep its reference meaning.  Inside a
+jit-compiled step the cast fuses into the psum's input/output, so compression
+halves ICI bytes at zero extra kernel cost.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface (torch/compression.py:20)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Default: no-op (torch/compression.py:34)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire (torch/compression.py:46)."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and \
+                tensor.dtype != jnp.float16:
+            tensor = tensor.astype(jnp.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.astype(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native wire compression: bfloat16 keeps the f32 exponent."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and \
+                tensor.dtype != jnp.bfloat16:
+            tensor = tensor.astype(jnp.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.astype(ctx)
+        return tensor
+
+
+class Compression:
+    """Option enum holder (torch/compression.py:70-74)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,292 @@
+"""Global runtime state + the init/info API surface.
+
+The reference's equivalent is the ``extern "C"`` surface of
+horovod/common/operations.cc:932-1405 (``horovod_init``, ``horovod_rank``,
+``horovod_size``, ``horovod_local_rank``..., process-set CRUD, built/enabled
+queries) reached from Python through the ctypes ``HorovodBasics`` wrapper
+(common/basics.py:29,51), plus the background-thread bring-up of
+``InitializeHorovodOnce`` (operations.cc:856).
+
+The TPU build needs no background communication thread for the compiled data
+plane — collectives live inside XLA programs — so ``init()`` reduces to:
+resolve knobs, discover topology, (optionally) join the multi-process runtime
+(``jax.distributed.initialize`` — the rendezvous analog of MPI_Init /
+Gloo HTTP rendezvous, operations.cc:417-450), build the global device
+``Mesh``, and register process sets.  The eager dispatch engine and its
+negotiation core (the surviving part of the reference's controller) are
+created lazily by ops/eager.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import config as _config
+from . import topology as _topology
+from .utils import get_logger
+
+
+class _GlobalState:
+    """Singleton per process (reference: HorovodGlobalState, global_state.h:39)."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.config: Optional[_config.Config] = None
+        self.topology: Optional[_topology.Topology] = None
+        self.mesh = None
+        self.process_set_table = None
+        self.eager_engine = None
+        self.timeline = None
+        self.elastic_enabled = False
+
+
+_state = _GlobalState()
+
+
+def _build_mesh(topo: _topology.Topology, cfg: _config.Config):
+    import jax
+    from jax.sharding import Mesh
+    devices = topo.devices if topo.devices else list(jax.devices())
+    return Mesh(np.asarray(devices), (cfg.mesh_axis,))
+
+
+def _maybe_join_distributed(cfg: _config.Config) -> None:
+    """Join the multi-process JAX runtime when launched by horovodrun.
+
+    The launcher injects HOROVOD_RANK/SIZE and the rendezvous address
+    (runner/gloo_run.py:66-78 analog); we translate that into
+    ``jax.distributed.initialize``, which plays the role of
+    MPI_Init_thread / Gloo HTTP rendezvous in BackgroundThreadLoop
+    (operations.cc:417-450)."""
+    rank = os.environ.get(_config.HOROVOD_RANK)
+    size = os.environ.get(_config.HOROVOD_SIZE)
+    addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+    port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+    if rank is None or size is None or int(size) <= 1 or addr is None:
+        return
+    # Must not touch the XLA backend (e.g. jax.devices/process_count) before
+    # jax.distributed.initialize — probe the distributed client state instead.
+    import jax
+    from jax._src import distributed as _jdist
+    if getattr(_jdist.global_state, "client", None) is not None:
+        return  # already initialized by the user
+    coordinator = f"{addr}:{int(port) + 1 if port else 9999}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(size),
+        process_id=int(rank),
+    )
+
+
+def init(comm: Optional[Sequence[int]] = None,
+         process_sets=None) -> None:
+    """Initialize the runtime (hvd.init analog, operations.cc:934 horovod_init).
+
+    Args:
+      comm: optional list of global ranks participating (reference: the
+        ``ranks`` argument of horovod_init restricting the global communicator).
+        Unsupported values raise — on TPU the job membership is fixed by the
+        launcher/slice, matching horovod_init_multi_comm's constraints.
+      process_sets: optional list of ``ProcessSet`` objects to register at
+        init, like hvd.init(process_sets=[...]) (common/basics.py:51).
+    """
+    from . import process_sets as _ps
+
+    with _state.lock:
+        if _state.initialized:
+            return
+        cfg = _config.Config.from_env()
+        _maybe_join_distributed(cfg)
+        topo = _topology.detect(cfg)
+        if comm is not None and list(comm) != list(range(topo.size)):
+            raise ValueError(
+                "horovod_tpu.init(comm=...) with a strict subset of ranks is "
+                "not supported on TPU; use process sets instead "
+                "(process_sets.add_process_set)")
+        _state.config = cfg
+        _state.topology = topo
+        _state.mesh = _build_mesh(topo, cfg)
+        _state.process_set_table = _ps.ProcessSetTable(topo.num_slots)
+        if process_sets:
+            for ps in process_sets:
+                _state.process_set_table.register(ps)
+        if cfg.timeline_path and topo.rank == 0:
+            # Rank 0 writes the trace, like the reference coordinator
+            # (HOROVOD_TIMELINE, operations.cc:1077).
+            from .timeline import Timeline
+            _state.timeline = Timeline(cfg.timeline_path,
+                                       mark_cycles=cfg.timeline_mark_cycles,
+                                       rank=topo.rank)
+        _state.initialized = True
+        get_logger().info(
+            "horovod_tpu initialized: rank=%d size=%d local=%d/%d cross=%d/%d "
+            "slots=%d mesh=%s", topo.rank, topo.size, topo.local_rank,
+            topo.local_size, topo.cross_rank, topo.cross_size, topo.num_slots,
+            tuple(_state.mesh.shape.items()))
+
+
+def shutdown() -> None:
+    """Tear down (horovod_shutdown, operations.cc)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        _state.initialized = False
+        _state.mesh = None
+        _state.topology = None
+        _state.process_set_table = None
+        _state.eager_engine = None
+
+
+atexit.register(shutdown)
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise ValueError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() "
+            "first (reference error string: operations.cc horovod_rank)")
+    return _state
+
+
+def is_initialized() -> bool:
+    """horovod_is_initialized (operations.cc)."""
+    return _state.initialized
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Runtime timeline start (horovod_start_timeline, operations.cc:1077)."""
+    from .timeline import Timeline
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(file_path, mark_cycles=mark_cycles,
+                           rank=st.topology.rank)
+
+
+def stop_timeline() -> None:
+    """horovod_stop_timeline."""
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None
+
+
+def rank() -> int:
+    """Global process rank (horovod_rank, operations.cc:1000)."""
+    return _require_init().topology.rank
+
+
+def size() -> int:
+    """Global number of ranks (horovod_size)."""
+    return _require_init().topology.size
+
+
+def local_rank() -> int:
+    """Rank within the node (horovod_local_rank)."""
+    return _require_init().topology.local_rank
+
+
+def local_size() -> int:
+    """Ranks on this node (horovod_local_size)."""
+    return _require_init().topology.local_size
+
+
+def cross_rank() -> int:
+    """Node index (horovod_cross_rank)."""
+    return _require_init().topology.cross_rank
+
+
+def cross_size() -> int:
+    """Number of nodes (horovod_cross_size)."""
+    return _require_init().topology.cross_size
+
+
+def num_slots() -> int:
+    """Total accelerator chips in the job — the mesh axis size.
+
+    TPU extension: the reference's process==GPU identity splits on TPU where
+    one process drives several chips; gradient averaging divides by this."""
+    return _require_init().topology.num_slots
+
+
+def local_slots() -> int:
+    return _require_init().topology.local_slots
+
+
+def mesh():
+    """The global device mesh (jax.sharding.Mesh) over every chip."""
+    return _require_init().mesh
+
+
+def mesh_axis() -> str:
+    return _require_init().config.mesh_axis
+
+
+def is_homogeneous() -> bool:
+    """horovod_is_homogeneous (operations.cc): equal slots per node."""
+    return _require_init().topology.is_homogeneous
+
+
+# ---------------------------------------------------------------------------
+# Built/enabled feature queries (operations.cc:1050-1140 horovod_*_built /
+# horovod_*_enabled).  The TPU build has exactly one backend — XLA collectives
+# — so the legacy backend queries answer False and xla answers True; they are
+# kept so reference scripts probing capabilities keep running.
+# ---------------------------------------------------------------------------
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """TPU build: the XLA-collective backend is always present."""
+    return True
+
+
+def xla_enabled() -> bool:
+    return True

@@ -1,0 +1,349 @@
+"""Axis-level collective primitives — the TPU-native data plane.
+
+This module is the idiomatic replacement for the reference's entire L1/L2 stack
+(horovod/common/ops/{mpi,nccl,gloo,ccl}_operations + fusion-buffer memcpys,
+SURVEY.md §2.2): every collective is a pure function over a named mesh axis,
+meant to be traced inside ``jax.jit``/``shard_map`` so XLA lowers it directly
+onto ICI (and DCN across slices).  There is no fusion buffer here — XLA's
+collective combiner plays that role in compiled programs; the explicit fusion
+planner survives only on the eager path (ops/eager.py + the C++ core).
+
+Semantics parity (reference symbols cited per function):
+
+* ``allreduce``   — MPI_Allreduce/ncclAllReduce analog; ReduceOp
+  {AVERAGE, SUM, ADASUM, MIN, MAX, PRODUCT} from message.h:43 plus
+  prescale/postscale factors carried by Request (message.h:59).
+* ``allgather``   — concat along axis 0 (collective_operations.h:126).
+* ``broadcast``   — root's tensor to all (collective_operations.h:177).
+* ``alltoall``    — equal-split axis-0 exchange (collective_operations.h:188);
+  uneven splits are an eager-path feature (XLA needs static shapes).
+* ``reducescatter`` — psum_scatter; the reference gives the first
+  ``dim0 % size`` ranks one extra row (collective_operations.cc
+  ComputeOutputShapeForRank) — under SPMD every shard must have equal shape, so
+  uneven dim0 is zero-padded; see ``reducescatter_padded_size``.
+* gradients: these are ordinary differentiable lax collectives, which yields
+  exactly the gradient table the reference registers by hand
+  (tensorflow/mpi_ops.py:115-537): allreduce grad = allreduce, allgather grad =
+  reduce-scatter slice, broadcast grad = reduce-to-root, alltoall grad =
+  inverse alltoall.
+
+Process sets (process_set.h:26) appear here as a static ``members`` tuple of
+slot indices.  XLA replica groups (``axis_index_groups``) must form an
+equal-size partition of the axis, which arbitrary subsets don't satisfy, so
+subset collectives use the *mask* formulation: reduce masked values over the
+full axis (non-members contribute the identity element) and restore
+non-members' inputs afterwards.  On the torus this costs the same as a
+full-axis collective — the right trade on ICI, where partial rings don't beat
+the full ring for moderate subset sizes — and it keeps every program total
+over the mesh as SPMD requires.  Equal partitions (e.g. hierarchical
+node-local groups) can still pass native ``groups``.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction operators (message.h:43 ReduceOp enum, same numbering)."""
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Horovod-API-compatible aliases (horovod.torch exposes these as module attrs).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def _apply_scale(x: jax.Array, factor: float) -> jax.Array:
+    if factor == 1.0:
+        return x
+    # Scale in f32 for low-precision inputs, mirroring the reference's fp16
+    # SIMD scale path (collective_operations.h:96-124) which avoids fp16
+    # rounding of the scale factor itself.
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return (x * factor).astype(x.dtype)
+    return x * factor
+
+
+def _n_participants(axis_name: str, members) -> int:
+    return len(members) if members is not None else lax.axis_size(axis_name)
+
+
+def _member_mask(members: Sequence[int], axis_name: str):
+    idx = lax.axis_index(axis_name)
+    return jnp.isin(idx, jnp.asarray(members, dtype=jnp.int32)), idx
+
+
+def _group_rank(members: Sequence[int], idx):
+    """Rank within the member list for the calling slot (members is sorted)."""
+    return jnp.searchsorted(jnp.asarray(members, dtype=jnp.int32), idx)
+
+
+def _identity_for(op: ReduceOp, dtype):
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return jnp.zeros((), dtype)
+    if op == ReduceOp.MIN:
+        return (jnp.array(jnp.iinfo(dtype).max, dtype)
+                if jnp.issubdtype(dtype, jnp.integer)
+                else jnp.array(jnp.inf, dtype))
+    if op == ReduceOp.MAX:
+        return (jnp.array(jnp.iinfo(dtype).min, dtype)
+                if jnp.issubdtype(dtype, jnp.integer)
+                else jnp.array(-jnp.inf, dtype))
+    if op == ReduceOp.PRODUCT:
+        return jnp.ones((), dtype)
+    raise ValueError(f"no identity for {op!r}")
+
+
+def allreduce(x: jax.Array,
+              op: ReduceOp = ReduceOp.AVERAGE,
+              *,
+              axis_name: str = "hvd",
+              members: Optional[Tuple[int, ...]] = None,
+              groups=None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> jax.Array:
+    """Allreduce over the mesh axis (EnqueueTensorAllreduce analog,
+    operations.cc:1408, executed as ncclAllReduce in the reference).
+
+    ``members``: static subset of slot indices (a process set); non-member
+    slots pass their input through unchanged."""
+    x_orig = x
+    x = _apply_scale(x, prescale_factor)
+    n = _n_participants(axis_name, members)
+    masked = x
+    if members is not None:
+        mask, _ = _member_mask(members, axis_name)
+        ident = _identity_for(op if op != ReduceOp.ADASUM else ReduceOp.SUM,
+                              x.dtype)
+        masked = jnp.where(mask, x, ident)
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        r = lax.psum(masked, axis_name, axis_index_groups=groups)
+        if op == ReduceOp.AVERAGE:
+            r = r // n if jnp.issubdtype(r.dtype, jnp.integer) else r / n
+    elif op == ReduceOp.MIN:
+        r = lax.pmin(masked, axis_name, axis_index_groups=groups)
+    elif op == ReduceOp.MAX:
+        r = lax.pmax(masked, axis_name, axis_index_groups=groups)
+    elif op == ReduceOp.PRODUCT:
+        # No pprod primitive: gather then row-reduce; XLA fuses the reduction.
+        g = lax.all_gather(masked, axis_name, axis_index_groups=groups, axis=0)
+        r = jnp.prod(g, axis=0).astype(x.dtype)
+    elif op == ReduceOp.ADASUM:
+        from . import adasum as _adasum
+        r = _adasum.adasum_allreduce(x, axis_name=axis_name, members=members)
+    else:
+        raise ValueError(f"Unsupported reduce op: {op!r}")
+    r = _apply_scale(r, postscale_factor)
+    if members is not None:
+        # Non-members get their ORIGINAL input back — no pre/postscale
+        # (Horovod semantics: they never called the op).
+        mask, _ = _member_mask(members, axis_name)
+        r = jnp.where(mask, r, x_orig.astype(r.dtype))
+    return r
+
+
+def grouped_allreduce(tensors: Sequence[jax.Array],
+                      op: ReduceOp = ReduceOp.AVERAGE,
+                      *,
+                      axis_name: str = "hvd",
+                      members: Optional[Tuple[int, ...]] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List[jax.Array]:
+    """All-or-nothing grouped allreduce (EnqueueTensorAllreduces,
+    operations.cc grouped variants; GroupTable semantics group_table.h:31).
+
+    Under jit the group contract is trivially satisfied — ops execute in
+    program order — and passing the whole list to one ``lax.psum`` lets XLA's
+    combiner fuse them into few large ICI transfers (the compiled-path
+    equivalent of the 128 MB fusion buffer, operations.cc:519).
+    """
+    tensors = list(tensors)
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM) and members is None:
+        scaled = [_apply_scale(t, prescale_factor) for t in tensors]
+        reduced = lax.psum(tuple(scaled), axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            reduced = tuple(
+                (r // n if jnp.issubdtype(r.dtype, jnp.integer) else r / n)
+                for r in reduced)
+        return [_apply_scale(r, postscale_factor) for r in reduced]
+    return [
+        allreduce(t, op, axis_name=axis_name, members=members,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor)
+        for t in tensors
+    ]
+
+
+def allgather(x: jax.Array,
+              *,
+              axis_name: str = "hvd",
+              members: Optional[Tuple[int, ...]] = None,
+              groups=None) -> jax.Array:
+    """Concatenate each participant's tensor along axis 0
+    (AllgatherOp, collective_operations.h:126; MPI_Allgatherv in reference).
+
+    SPMD requires equal shapes per participant; ragged dim0 (allgatherv) is
+    provided on the eager path via pad-to-max + size side channel
+    (SURVEY.md §7 "dynamic shapes").  With ``members``, every slot computes the
+    member-only concat (non-members receive it too; the public API layer
+    discards it for them — Horovod semantics are that non-members simply don't
+    call the op)."""
+    if members is None:
+        return lax.all_gather(x, axis_name, axis_index_groups=groups,
+                              axis=0, tiled=True)
+    stacked = lax.all_gather(x, axis_name, axis=0)  # [n, d0, ...]
+    sel = stacked[jnp.asarray(members, dtype=jnp.int32)]  # [k, d0, ...]
+    return sel.reshape((-1,) + sel.shape[2:])
+
+
+def grouped_allgather(tensors: Sequence[jax.Array],
+                      *,
+                      axis_name: str = "hvd",
+                      members: Optional[Tuple[int, ...]] = None) -> List[jax.Array]:
+    return [allgather(t, axis_name=axis_name, members=members)
+            for t in tensors]
+
+
+def broadcast(x: jax.Array,
+              root_rank: int = 0,
+              *,
+              axis_name: str = "hvd",
+              members: Optional[Tuple[int, ...]] = None) -> jax.Array:
+    """Root's tensor to every participant (BroadcastOp,
+    collective_operations.h:177; ncclBroadcast in reference).
+
+    Implemented as a masked psum — O(|x|) ICI traffic like a native broadcast,
+    no gather blow-up.  Its transpose is a masked reduce-to-root, which is
+    precisely the gradient the reference registers for broadcast
+    (tensorflow/mpi_ops.py broadcast grad).
+
+    With ``members``, ``root_rank`` is the *set-relative* root (the reference's
+    process-set-relative root, torch/mpi_ops.py broadcast_ process_set arg) and
+    non-members keep their own tensor."""
+    idx = lax.axis_index(axis_name)
+    root_global = members[root_rank] if members is not None else root_rank
+    is_root = idx == root_global
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.int32) if orig_dtype == jnp.bool_ else x
+    masked = jnp.where(is_root, xf, jnp.zeros_like(xf))
+    out = lax.psum(masked, axis_name)
+    out = out.astype(orig_dtype)
+    if members is not None:
+        mask, _ = _member_mask(members, axis_name)
+        out = jnp.where(mask, out, x)
+    return out
+
+
+def alltoall(x: jax.Array,
+             *,
+             axis_name: str = "hvd",
+             members: Optional[Tuple[int, ...]] = None,
+             groups=None) -> jax.Array:
+    """Equal-split all-to-all: row block i of my tensor goes to participant i
+    (AlltoallOp, collective_operations.h:188).  The uneven ``splits`` variant
+    (alltoallv) lives on the eager path.  This is also the Ulysses
+    sequence-parallel building block (SURVEY.md §5.8)."""
+    n = _n_participants(axis_name, members)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"alltoall requires dim0 ({x.shape[0]}) divisible by group size "
+            f"({n}) under jit; use eager alltoall with splits for ragged sends")
+    if members is None:
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                              axis_index_groups=groups, tiled=True)
+    # Subset path via full gather + static member selection + dynamic block
+    # slice at this slot's set-relative rank.
+    mask, idx = _member_mask(members, axis_name)
+    grank = _group_rank(members, idx)
+    blk = x.shape[0] // n
+    stacked = lax.all_gather(x, axis_name, axis=0)            # [N, d0, ...]
+    sel = stacked[jnp.asarray(members, dtype=jnp.int32)]      # [k, d0, ...]
+    start = (jnp.zeros((sel.ndim,), jnp.int32)
+             .at[1].set((grank * blk).astype(jnp.int32)))
+    block = lax.dynamic_slice(sel, tuple(start),
+                              (n, blk) + x.shape[1:])         # [k, blk, ...]
+    out = block.reshape((-1,) + x.shape[1:])                  # [k*blk, ...]
+    return jnp.where(mask, out, x[:out.shape[0]]) if out.shape == x.shape \
+        else out
+
+
+def reducescatter_padded_size(dim0: int, n: int) -> int:
+    """Padded dim0 so every participant's shard is equal.
+
+    The reference hands the first ``dim0 % n`` ranks one extra row
+    (collective_operations.cc ComputeOutputShapeForRank); SPMD shards must be
+    uniform, so we pad up and let callers slice."""
+    return math.ceil(dim0 / n) * n
+
+
+def reducescatter(x: jax.Array,
+                  op: ReduceOp = ReduceOp.SUM,
+                  *,
+                  axis_name: str = "hvd",
+                  members: Optional[Tuple[int, ...]] = None,
+                  groups=None,
+                  prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0) -> jax.Array:
+    """Reduce then scatter row blocks (ReducescatterOp,
+    collective_operations.h:271; ncclReduceScatter).  Supports SUM and AVERAGE
+    (the reference's reducescatter ReduceOp surface)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM and AVERAGE")
+    n = _n_participants(axis_name, members)
+    x = _apply_scale(x, prescale_factor)
+    padded = reducescatter_padded_size(x.shape[0], n)
+    pad = padded - x.shape[0]
+    xp = x
+    if pad:
+        xp = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], dtype=x.dtype)], axis=0)
+    if members is None:
+        r = lax.psum_scatter(xp, axis_name, scatter_dimension=0,
+                             axis_index_groups=groups, tiled=True)
+    else:
+        mask, idx = _member_mask(members, axis_name)
+        grank = _group_rank(members, idx)
+        blk = padded // n
+        masked = jnp.where(mask, xp, jnp.zeros_like(xp))
+        total = lax.psum(masked, axis_name)                   # [padded, ...]
+        start = (jnp.zeros((total.ndim,), jnp.int32)
+                 .at[0].set((grank * blk).astype(jnp.int32)))
+        r = lax.dynamic_slice(total, tuple(start), (blk,) + x.shape[1:])
+    if op == ReduceOp.AVERAGE:
+        r = r // n if jnp.issubdtype(r.dtype, jnp.integer) else r / n
+    return _apply_scale(r, postscale_factor)
+
+
+def grouped_reducescatter(tensors: Sequence[jax.Array],
+                          op: ReduceOp = ReduceOp.SUM,
+                          *,
+                          axis_name: str = "hvd",
+                          members: Optional[Tuple[int, ...]] = None) -> List[jax.Array]:
+    return [reducescatter(t, op, axis_name=axis_name, members=members)
+            for t in tensors]
+
+
+def barrier(*, axis_name: str = "hvd") -> jax.Array:
+    """Synchronization barrier (BarrierOp, collective_operations.h:335).
+    In a compiled program this is a collective the schedule cannot reorder
+    across; eagerly, ops/eager.py blocks on the result."""
+    return lax.psum(jnp.zeros((), dtype=jnp.int32), axis_name)

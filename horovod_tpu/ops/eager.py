@@ -1,0 +1,231 @@
+"""Eager (op-by-op) collective dispatch engine.
+
+Reference analog: the enqueue→negotiate→execute pipeline of L3-L5 — per-op
+enqueue (EnqueueTensorAllreduce, operations.cc:1408), the HandleManager int
+handle → status map of the Torch binding (torch/handle_manager.h:48,
+mpi_ops_v2.cc:76), and background execution.  On TPU the execution itself is a
+jit-compiled XLA collective over the device mesh; "async" comes for free from
+JAX's asynchronous dispatch, so a handle wraps the not-yet-materialized output
+arrays and ``synchronize`` is ``block_until_ready`` — no background thread, no
+cycle-time tax (the reference itself forces cycle time 0 on its XLA path,
+operations.cc:528-534).
+
+Three process modes (horovod_tpu/topology.py):
+
+* **single rank** (size==1, the one-real-chip dev box): Horovod np=1
+  semantics — collectives are local transforms (scale/slice only).
+* **emulated ranks** (``HVD_TPU_EMULATE_RANKS=N`` over N local devices): eager
+  tensors are *stacked* per-rank values of shape ``[N, ...]``; the engine
+  shard_maps the axis-level collective over the mesh and returns the stacked
+  per-rank results.  This is the hermetic analog of the reference running its
+  parallel test suite under ``horovodrun -np N`` on CPU Gloo (SURVEY.md §4).
+* **multi-process** (one controller per host): each process contributes its
+  local tensor; the engine forms a global array over a one-device-per-process
+  submesh and runs the same compiled collective; the result shard comes back
+  to the caller.  Issue-order consistency across processes is the negotiation
+  contract — enforced by the C++ controller core (csrc/) exactly because
+  eager per-rank op order is nondeterministic (controller.cc:74).
+
+Compiled executables are cached per (op, shape, dtype, static params) — the
+response-cache analog for the data plane (response_cache.h:45 caches
+negotiation results; XLA's compilation cache plays that role here, and the
+C++ ResponseCache covers the negotiation side).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import collective_ops as C
+from ..utils import get_logger
+
+
+class HandleManager:
+    """int handle → result pytree (torch/handle_manager.h:48 analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+
+    def allocate(self, result) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = result
+            return h
+
+    def poll(self, handle: int) -> bool:
+        """True when the output is materialized (hvd.poll,
+        torch/mpi_ops.py poll)."""
+        with self._lock:
+            res = self._results[handle]
+        leaves = jax.tree_util.tree_leaves(res)
+        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+
+    def wait(self, handle: int):
+        """Block and return outputs (hvd.synchronize)."""
+        with self._lock:
+            if handle not in self._results:
+                raise ValueError(f"unknown or already-synchronized handle {handle}")
+            res = self._results.pop(handle)
+        return jax.block_until_ready(res)
+
+
+class EagerEngine:
+    def __init__(self, mesh: Mesh, axis: str, topology):
+        self.mesh = mesh
+        self.axis = axis
+        self.topo = topology
+        self.handles = HandleManager()
+        self._exec_cache: Dict[Tuple, Any] = {}
+        self._eager_mesh: Optional[Mesh] = None
+        self._names_in_flight = set()
+
+    # -- mode helpers -------------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self.topo.size
+
+    def _multiproc_mesh(self) -> Mesh:
+        """One device per process — the controller-plane mesh used to move
+        per-process eager tensors (the reference's GLOBAL communicator,
+        common.h:176-180)."""
+        if self._eager_mesh is None:
+            per_proc: Dict[int, Any] = {}
+            for d in self.mesh.devices.flat:
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[p] for p in sorted(per_proc)]
+            self._eager_mesh = Mesh(np.asarray(devs), (self.axis,))
+        return self._eager_mesh
+
+    # -- compiled-callable cache -------------------------------------------
+
+    def _compiled(self, key: Tuple, build):
+        fn = self._exec_cache.get(key)
+        if fn is None:
+            fn = build()
+            self._exec_cache[key] = fn
+        return fn
+
+    def _stacked_run(self, kind: str, body, tensors: Sequence[jax.Array],
+                     static_params: Tuple, mesh: Mesh):
+        """shard_map ``body`` over ``mesh`` with stacked [N, ...] inputs and
+        stacked [N, ...] outputs; jitted + cached."""
+        avals = tuple((t.shape, str(t.dtype)) for t in tensors)
+        key = (kind, avals, static_params, id(mesh))
+
+        def build():
+            def mapped(*xs):
+                def inner(*xs_local):
+                    outs = body(*(x[0] for x in xs_local))
+                    if not isinstance(outs, (tuple, list)):
+                        outs = (outs,)
+                    return tuple(o[None] for o in outs)
+                return jax.shard_map(
+                    inner, mesh=mesh,
+                    in_specs=tuple(P(self.axis) for _ in xs),
+                    out_specs=P(self.axis))(*xs)
+            return jax.jit(mapped)
+
+        return self._compiled(key, build)(*tensors)
+
+    # -- input normalization ------------------------------------------------
+
+    def _as_stacked(self, t: jax.Array) -> jax.Array:
+        """Emulated mode: tensors are per-rank stacks [N, ...]."""
+        t = jnp.asarray(t)
+        if t.ndim == 0 or t.shape[0] != self.n:
+            raise ValueError(
+                f"emulated-rank eager ops take stacked per-rank tensors with "
+                f"leading dim {self.n}; got shape {t.shape}. Wrap per-rank "
+                f"values with jnp.stack([...]).")
+        return t
+
+    def _to_global(self, t: jax.Array) -> jax.Array:
+        """Multi-process mode: local [...] → global stacked [size, ...]."""
+        mesh = self._multiproc_mesh()
+        t = jnp.asarray(t)
+        sharding = NamedSharding(mesh, P(self.axis, *([None] * t.ndim)))
+        local = jax.device_put(t[None], self.mesh.local_mesh.devices.flat[0])
+        return jax.make_array_from_single_device_arrays(
+            (self.n,) + t.shape, sharding, [local])
+
+    def _from_global(self, g: jax.Array) -> jax.Array:
+        return g.addressable_data(0)[0]
+
+    # -- generic dispatch ---------------------------------------------------
+
+    def run(self, kind: str, body, tensors: List[jax.Array],
+            static_params: Tuple, single_rank_fn,
+            name: Optional[str] = None) -> List[jax.Array]:
+        """Dispatch one eager collective; returns per-rank outputs
+        (stacked in emulated mode, local otherwise).
+
+        ``name`` reproduces the reference's tensor-name contract: a second
+        in-flight collective under the same name raises DuplicateNameError
+        (common.h:239), and named ops get timeline lifecycle events."""
+        from .. import core as _core
+        tl = _core._state.timeline
+        label = name or kind
+        self.claim_name(name)
+        try:
+            if tl is not None:
+                tl.negotiate_start(label, kind.upper())
+                tl.negotiate_rank_ready(label, self.topo.rank)
+                tl.negotiate_end(label, kind.upper())
+                tl.start(label, kind.upper())
+            try:
+                if self.n == 1:
+                    return [jnp.asarray(r) for r in single_rank_fn(
+                        [jnp.asarray(t) for t in tensors])]
+                if self.topo.emulated:
+                    stacked = [self._as_stacked(t) for t in tensors]
+                    if tl is None:
+                        outs = self._stacked_run(kind, body, stacked,
+                                                 static_params, self.mesh)
+                    else:
+                        with tl.activity(label, "XLA_EXECUTE"):
+                            outs = self._stacked_run(kind, body, stacked,
+                                                     static_params, self.mesh)
+                    return list(outs) if isinstance(outs, (tuple, list)) \
+                        else [outs]
+                # Multi-process: global stacked arrays over per-process mesh.
+                mesh = self._multiproc_mesh()
+                global_ts = [self._to_global(t) for t in tensors]
+                outs = self._stacked_run(kind, body, global_ts, static_params,
+                                         mesh)
+                if not isinstance(outs, (tuple, list)):
+                    outs = [outs]
+                return [self._from_global(o) for o in outs]
+            finally:
+                if tl is not None:
+                    tl.end(label, kind.upper())
+        finally:
+            self.release_name(name)
+
+    # -- name bookkeeping (DUPLICATE_NAME_ERROR, common.h:239) --------------
+
+    def claim_name(self, name: Optional[str]):
+        if name is None:
+            return None
+        from ..exceptions import DuplicateNameError
+        if name in self._names_in_flight:
+            raise DuplicateNameError(
+                f"collective named {name!r} already in flight "
+                f"(reference: DUPLICATE_NAME_ERROR, common.h:239)")
+        self._names_in_flight.add(name)
+        return name
+
+    def release_name(self, name: Optional[str]):
+        if name is not None:
+            self._names_in_flight.discard(name)

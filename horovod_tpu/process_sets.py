@@ -1,0 +1,181 @@
+"""Process sets — collectives over rank subgroups, mapped to XLA replica groups.
+
+Reference: a process set is a subgroup of ranks with its own controller,
+tensor queue, response cache and sub-communicators
+(horovod/common/process_set.h:26,89); the Python surface is
+horovod/common/process_sets.py:18 (``ProcessSet``, ``global_process_set``,
+``add_process_set``, ``remove_process_set``) and registration happens in
+operations.cc:359,1262-1405 with dynamic add/remove gated by
+``HOROVOD_DYNAMIC_PROCESS_SETS``.
+
+TPU mapping: a process set over slot ranks becomes a static ``members`` tuple
+burned into the traced collective (ops/collective_ops.py lowers subsets via
+masked full-axis collectives, since XLA replica groups must form an equal-size
+partition of the axis).  The compiled program stays total over the mesh as
+SPMD requires; members get the group result, non-members keep their own value.
+Dynamic sets need no re-rendezvous: registering a set only changes the members
+burned into subsequently-traced programs (recompile on first use — see
+SURVEY.md §7 "Process sets ↔ replica groups").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from . import core as _core
+
+
+class ProcessSet:
+    """Subgroup of slot ranks (horovod/common/process_sets.py:18 analog).
+
+    Construct with an iterable of global slot ranks.  ``process_set_id`` is
+    assigned at registration (0 is the global set).
+    """
+
+    process_set_id: Optional[int]
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.process_set_id = None
+        self.ranks: Optional[List[int]] = (
+            sorted(set(int(r) for r in ranks)) if ranks is not None else None)
+
+    def size(self) -> Optional[int]:
+        """Number of ranks in the set (None before init for the global set)."""
+        if self.ranks is not None:
+            return len(self.ranks)
+        if _core.is_initialized():
+            return _core.num_slots()
+        return None
+
+    def rank(self) -> Optional[int]:
+        """This process's rank within the set, or None if excluded.
+
+        In emulated / single-controller mode the notion is per-slot; the
+        process-level answer uses slot 0 of this process, matching the
+        reference where process == slot."""
+        if not _core.is_initialized():
+            return None
+        my = _core.rank()
+        if self.ranks is None:
+            return my
+        if my in self.ranks:
+            return self.ranks.index(my)
+        return None
+
+    def included(self) -> bool:
+        return self.rank() is not None
+
+    def _resolved_ranks(self) -> List[int]:
+        if self.ranks is None:
+            return list(range(_core.num_slots()))
+        return self.ranks
+
+    def members(self) -> Optional[tuple]:
+        """Static member tuple for the collective layer, or None for the full
+        axis.  XLA replica groups must form an equal-size partition of the
+        axis, which arbitrary subsets don't satisfy — so subsets are lowered
+        via the mask formulation in ops/collective_ops.py instead."""
+        n = _core.num_slots()
+        resolved = self._resolved_ranks()
+        if len(resolved) == n:
+            return None  # full axis — fast un-grouped form
+        return tuple(resolved)
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={self.ranks if self.ranks is not None else 'global'})")
+
+
+class ProcessSetTable:
+    """id → ProcessSet registry (process_set.h ProcessSetTable analog).
+
+    Ids are assigned densely and never reused within a session, matching the
+    reference's stable-id contract that the response cache keys depend on."""
+
+    def __init__(self, num_slots: int):
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self.num_slots = num_slots
+        self.table: Dict[int, ProcessSet] = {}
+        g = ProcessSet()
+        g.process_set_id = 0
+        self.table[0] = g
+
+    @property
+    def global_set(self) -> ProcessSet:
+        return self.table[0]
+
+    def register(self, ps: ProcessSet) -> ProcessSet:
+        with self._lock:
+            if ps.process_set_id is not None:
+                return ps
+            ranks = ps._resolved_ranks() if ps.ranks is not None else None
+            if ranks is not None:
+                if not ranks:
+                    raise ValueError("process set must contain at least one rank")
+                if ranks[-1] >= self.num_slots or ranks[0] < 0:
+                    raise ValueError(
+                        f"process set ranks {ranks} out of range for "
+                        f"{self.num_slots} slots")
+                # Reference semantics: an existing identical set is returned
+                # rather than duplicated (operations.cc:1262 add returns the
+                # existing id).
+                for existing in self.table.values():
+                    if existing.ranks == ranks:
+                        ps.process_set_id = existing.process_set_id
+                        return existing
+            ps.process_set_id = self._next_id
+            self._next_id += 1
+            self.table[ps.process_set_id] = ps
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id == 0:
+                raise ValueError(
+                    "cannot remove the global process set (process_set.h)")
+            self.table.pop(ps.process_set_id, None)
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        try:
+            return self.table[process_set_id]
+        except KeyError:
+            raise ValueError(f"unknown process set id {process_set_id}")
+
+
+# Module-level convenience API mirroring horovod/common/process_sets.py.
+global_process_set = ProcessSet()
+global_process_set.process_set_id = 0
+
+
+def _table() -> ProcessSetTable:
+    st = _core._require_init()
+    return st.process_set_table
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a new process set after init
+    (horovod/common/process_sets.py add_process_set).  Accepts a ProcessSet
+    or a plain rank list."""
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    return _table().register(process_set)
+
+
+def remove_process_set(process_set: ProcessSet) -> bool:
+    """Deregister (dynamic process sets)."""
+    try:
+        _table().remove(process_set)
+        return True
+    except (ValueError, KeyError):
+        return False
+
+
+def process_set_included(process_set_id: int = 0) -> bool:
+    return _table().get(process_set_id).included()
+
+
+def get_process_set_ids() -> List[int]:
+    return sorted(_table().table.keys())

@@ -1,0 +1,151 @@
+"""Horovod-Timeline-compatible Chrome-trace profiler.
+
+Reference: horovod/common/timeline.{h,cc} — rank 0 writes a Chrome trace JSON
+(``HOROVOD_TIMELINE=/path`` or the ``horovod_start_timeline`` runtime API,
+operations.cc:1077).  Each tensor gets a lifecycle: NEGOTIATE_<OP> instant
+events as ranks' requests arrive, then a top-level op state, then nested
+*activities* named by the executing op (QUEUE, WAIT_FOR_DATA,
+MEMCPY_IN_FUSION_BUFFER, NCCL_ALLREDUCE..., macros common.h:80-114).  Events
+flow through a lock-free SPSC queue to a dedicated writer thread
+(timeline.h:84-92) so the hot path never blocks on IO.
+
+TPU build: the host-side lifecycle is identical (NEGOTIATE → op → activities
+like QUEUE / TRACE_CACHE / XLA_EXECUTE); the *device* plane is covered by
+``jax.profiler`` traces which a user can overlay — XLA programs time their own
+collectives, the host runtime cannot see inside them (SURVEY.md §5.1).
+Events go through a queue.Queue to a writer thread; the file is valid
+Chrome-trace JSON (array form, openable in chrome://tracing / Perfetto).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Activity names preserved from the reference (common.h:80-114).
+QUEUE = "QUEUE"
+WAIT_FOR_DATA = "WAIT_FOR_DATA"
+WAIT_FOR_OTHER_TENSOR_DATA = "WAIT_FOR_OTHER_TENSOR_DATA"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+XLA_EXECUTE = "XLA_EXECUTE"
+TRACE_CACHE_HIT = "TRACE_CACHE_HIT"
+TRACE_COMPILE = "TRACE_COMPILE"
+
+
+class Timeline:
+    """Chrome-trace writer with a background writer thread
+    (TimelineWriter, timeline.h:48)."""
+
+    def __init__(self, path: str, mark_cycles: bool = False, rank: int = 0):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self.rank = rank
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=1 << 16)
+        self._start = time.monotonic_ns()
+        self._closed = False
+        self._fh = open(path, "w")
+        self._fh.write("[\n")
+        self._first = True
+        self._writer = threading.Thread(target=self._drain, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._writer.start()
+        self._emit_meta()
+
+    # -- event api ----------------------------------------------------------
+
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start) / 1e3
+
+    def _put(self, ev: dict) -> None:
+        if self._closed:
+            return
+        try:
+            self._queue.put_nowait(ev)
+        except queue.Full:
+            pass  # drop rather than stall the hot path (reference SPSC behavior)
+
+    def _emit_meta(self):
+        self._put({"name": "process_name", "ph": "M", "pid": self.rank,
+                   "args": {"name": f"horovod_tpu rank {self.rank}"}})
+
+    def negotiate_start(self, tensor_name: str, op_type: str):
+        """NEGOTIATE_<OP> phase begin (timeline.cc NegotiateStart)."""
+        self._put({"name": f"NEGOTIATE_{op_type}", "ph": "B",
+                   "ts": self._ts_us(), "pid": self.rank, "tid": tensor_name})
+
+    def negotiate_rank_ready(self, tensor_name: str, req_rank: int):
+        """Instant event per rank whose request arrived (timeline.cc
+        NegotiateRankReady)."""
+        self._put({"name": str(req_rank), "ph": "i", "s": "t",
+                   "ts": self._ts_us(), "pid": self.rank, "tid": tensor_name})
+
+    def negotiate_end(self, tensor_name: str, op_type: str):
+        self._put({"name": f"NEGOTIATE_{op_type}", "ph": "E",
+                   "ts": self._ts_us(), "pid": self.rank, "tid": tensor_name})
+
+    def start(self, tensor_name: str, op_type: str):
+        """Top-level op state begin (timeline.cc Start)."""
+        self._put({"name": op_type, "ph": "B", "ts": self._ts_us(),
+                   "pid": self.rank, "tid": tensor_name})
+
+    def activity_start(self, tensor_name: str, activity: str):
+        self._put({"name": activity, "ph": "B", "ts": self._ts_us(),
+                   "pid": self.rank, "tid": tensor_name})
+
+    def activity_end(self, tensor_name: str, activity: str):
+        self._put({"name": activity, "ph": "E", "ts": self._ts_us(),
+                   "pid": self.rank, "tid": tensor_name})
+
+    def end(self, tensor_name: str, op_type: str):
+        self._put({"name": op_type, "ph": "E", "ts": self._ts_us(),
+                   "pid": self.rank, "tid": tensor_name})
+
+    def mark_cycle(self):
+        """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,
+        timeline.cc MarkCycle)."""
+        if self.mark_cycles:
+            self._put({"name": "CYCLE", "ph": "i", "s": "g",
+                       "ts": self._ts_us(), "pid": self.rank, "tid": "cycles"})
+
+    class _Activity:
+        def __init__(self, tl, name, activity):
+            self.tl, self.name, self.activity = tl, name, activity
+
+        def __enter__(self):
+            self.tl.activity_start(self.name, self.activity)
+            return self
+
+        def __exit__(self, *exc):
+            self.tl.activity_end(self.name, self.activity)
+            return False
+
+    def activity(self, tensor_name: str, activity: str) -> "_Activity":
+        return self._Activity(self, tensor_name, activity)
+
+    # -- writer thread ------------------------------------------------------
+
+    def _drain(self):
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            line = json.dumps(ev)
+            if not self._first:
+                self._fh.write(",\n")
+            self._first = False
+            self._fh.write(line)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._writer.join(timeout=5)
+        self._fh.write("\n]\n")
+        self._fh.flush()
+        self._fh.close()

@@ -1,0 +1,151 @@
+"""TPU slice topology discovery and rank bookkeeping.
+
+The reference derives rank/local_rank/cross_rank from MPI communicators split by
+shared memory (mpi_controller.cc:75-81) or from launcher-injected env vars
+(runner/gloo_run.py:66-78: ``HOROVOD_RANK``, ``HOROVOD_SIZE``,
+``HOROVOD_LOCAL_RANK``, ``HOROVOD_CROSS_RANK``...).  On TPU the equivalent
+information comes from (a) the launcher env, (b) an already-initialized
+``jax.distributed`` runtime (process index/count + local vs. global devices),
+or (c) a single-process fallback.
+
+Two levels of identity coexist (see SURVEY.md §2.3 TPU mapping):
+
+* **process level** — ``rank``/``size``/``local_*``/``cross_*`` exactly as the
+  reference reports them; this is what user scripts branch on ("rank 0 writes
+  checkpoints").
+* **slot (chip) level** — the data plane is a ``jax.sharding.Mesh`` over every
+  chip in the job; ``num_slots`` is its size.  Gradient averaging divides by
+  ``num_slots``, matching the reference where one process drives one GPU so the
+  two notions collapse.
+
+Emulation: with ``HVD_TPU_EMULATE_RANKS=N`` (tests, CPU) a single process
+presents N local devices as N ranks, which is how the hermetic test suite
+exercises multi-rank numerics — the analog of the reference running its
+parallel suite under ``horovodrun -np 2`` on CPU Gloo (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+from typing import List, Optional
+
+from . import config as _config
+
+
+@dataclasses.dataclass
+class Topology:
+    # Process-level identity (reference: horovod_rank/size/... C API,
+    # operations.cc:934-1050).
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    # Slot (chip) level: the device mesh over which XLA collectives run.
+    num_slots: int
+    local_slots: int
+    # Devices backing the mesh (jax devices, process-local list for this proc).
+    devices: list = dataclasses.field(default_factory=list, repr=False)
+    local_devices: list = dataclasses.field(default_factory=list, repr=False)
+    emulated: bool = False
+    hostname: str = ""
+    # Per-node slot counts when derivable from the device list (multi-
+    # controller: devices carry process_index); empty = assume homogeneous.
+    slots_per_node: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_homogeneous(self) -> bool:
+        """True when every node has the same number of slots
+        (reference: controller.h is_homogeneous_, computed by comparing
+        local sizes across nodes in mpi_controller.cc:75-81)."""
+        if self.slots_per_node:
+            return len(set(self.slots_per_node)) <= 1
+        return True
+
+
+def _from_launcher_env() -> Optional[Topology]:
+    """Topology from launcher-injected env (runner/gloo_run.py:66-78 analog)."""
+    rank = os.environ.get(_config.HOROVOD_RANK)
+    size = os.environ.get(_config.HOROVOD_SIZE)
+    if rank is None or size is None:
+        return None
+    rank, size = int(rank), int(size)
+    local_rank = int(os.environ.get(_config.HOROVOD_LOCAL_RANK, 0))
+    local_size = int(os.environ.get(_config.HOROVOD_LOCAL_SIZE, 1))
+    cross_rank = int(os.environ.get(_config.HOROVOD_CROSS_RANK, rank))
+    cross_size = int(os.environ.get(_config.HOROVOD_CROSS_SIZE, size))
+    return Topology(
+        rank=rank, size=size,
+        local_rank=local_rank, local_size=local_size,
+        cross_rank=cross_rank, cross_size=cross_size,
+        num_slots=size, local_slots=1,
+        hostname=os.environ.get(_config.HOROVOD_HOSTNAME, socket.gethostname()),
+    )
+
+
+def detect(cfg: _config.Config) -> Topology:
+    """Resolve process + slot topology.
+
+    Resolution order: launcher env > jax.distributed multi-process > single
+    process (with optional rank emulation over local devices).
+    """
+    import jax
+
+    topo = _from_launcher_env()
+    local_devices = list(jax.local_devices())
+    all_devices = list(jax.devices())
+
+    if topo is not None:
+        topo.devices = all_devices
+        topo.local_devices = local_devices
+        topo.num_slots = max(topo.size, len(all_devices))
+        topo.local_slots = len(local_devices)
+        return topo
+
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        # Multi-controller: one process per host is the TPU norm; local/cross
+        # follow the reference's shared-memory split semantics where "local"
+        # means co-resident on a node (mpi_controller.cc:75-81).
+        rank = jax.process_index()
+        counts = {}
+        for d in all_devices:
+            counts[d.process_index] = counts.get(d.process_index, 0) + 1
+        return Topology(
+            rank=rank, size=n_proc,
+            local_rank=0, local_size=1,
+            cross_rank=rank, cross_size=n_proc,
+            num_slots=len(all_devices), local_slots=len(local_devices),
+            devices=all_devices, local_devices=local_devices,
+            hostname=socket.gethostname(),
+            slots_per_node=[counts[p] for p in sorted(counts)],
+        )
+
+    # Single process. Optionally emulate N ranks over N local devices.
+    emulate = cfg.emulate_ranks
+    if emulate:
+        if emulate > len(local_devices):
+            raise ValueError(
+                f"HVD_TPU_EMULATE_RANKS={emulate} exceeds the "
+                f"{len(local_devices)} available local devices")
+        devices = local_devices[:emulate]
+        return Topology(
+            rank=0, size=emulate,
+            local_rank=0, local_size=emulate,
+            cross_rank=0, cross_size=1,
+            num_slots=emulate, local_slots=emulate,
+            devices=devices, local_devices=devices,
+            emulated=True, hostname=socket.gethostname(),
+        )
+
+    return Topology(
+        rank=0, size=1,
+        local_rank=0, local_size=1,
+        cross_rank=0, cross_size=1,
+        num_slots=len(all_devices), local_slots=len(local_devices),
+        devices=all_devices, local_devices=local_devices,
+        hostname=socket.gethostname(),
+    )

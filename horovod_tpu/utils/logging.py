@@ -1,0 +1,38 @@
+"""Rank-tagged logging (reference: common/logging.h:16,56 LOG(level, rank)
+macros with HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME env control)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+_logger = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        from .. import config as _config
+        _logger = logging.getLogger("horovod_tpu")
+        level = os.environ.get(_config.HOROVOD_LOG_LEVEL, "warning").lower()
+        _logger.setLevel(_LEVELS.get(level, logging.WARNING))
+        if not _logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            hide_ts = _config.env_bool(_config.HOROVOD_LOG_HIDE_TIME)
+            fmt = "[%(name)s] %(message)s" if hide_ts else \
+                "%(asctime)s [%(name)s] %(message)s"
+            handler.setFormatter(logging.Formatter(fmt))
+            _logger.addHandler(handler)
+        _logger.propagate = False
+    return _logger

@@ -1,0 +1,29 @@
+"""Hermetic test environment: 8 virtual CPU devices emulate an 8-chip slice.
+
+This is the TPU analog of the reference running its parallel suite under
+``horovodrun -np 2`` with CPU Gloo as the hermetic backend (SURVEY.md §4):
+multi-chip is simulated as multi-device in one process via
+``--xla_force_host_platform_device_count``, and every collective really
+executes through XLA's CPU collective implementation.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("HVD_TPU_EMULATE_RANKS", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd8():
+    """Initialized runtime with 8 emulated ranks; torn down after the test."""
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

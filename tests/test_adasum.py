@@ -1,0 +1,114 @@
+"""Adasum numerics vs a NumPy model of the reference recursion.
+
+Mirrors test/parallel/test_adasum_pytorch.py / test_adasum_tensorflow.py:
+the reference validates its recursive halving-doubling against an explicit
+model of the pairwise combine math (adasum.h:396-409).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import adasum as A
+from horovod_tpu.ops import collective_ops as C
+from tests.test_collective_ops import run_spmd
+
+N = 8
+
+
+def np_pair_combine(a, b):
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    dot = np.sum(a * b)
+    na = np.sum(a * a)
+    nb = np.sum(b * b)
+    acoeff = 1.0 - dot / (2 * na) if na > 0 else 1.0
+    bcoeff = 1.0 - dot / (2 * nb) if nb > 0 else 1.0
+    return acoeff * a + bcoeff * b
+
+
+def np_adasum_tree(tensors):
+    """Binary-tree reduction matching the distance-doubling recursion."""
+    level = [t.astype(np.float64) for t in tensors]
+    while len(level) > 1:
+        level = [np_pair_combine(level[i], level[i + 1])
+                 for i in range(0, len(level), 2)]
+    return level[0]
+
+
+def test_pair_combine_parallel_gradients_average():
+    # Identical tensors: dot = ||a||^2 = ||b||^2 → coeffs 1/2 → average = a.
+    a = np.random.RandomState(0).randn(16).astype(np.float32)
+    out = np.asarray(A.pair_combine(jnp.asarray(a), jnp.asarray(a)))
+    np.testing.assert_allclose(out, a, rtol=1e-5)
+
+
+def test_pair_combine_orthogonal_gradients_sum():
+    # Orthogonal tensors: dot = 0 → coeffs 1 → plain sum.
+    a = np.zeros(8, np.float32); a[0] = 3.0
+    b = np.zeros(8, np.float32); b[1] = 4.0
+    out = np.asarray(A.pair_combine(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(out, a + b, rtol=1e-6)
+
+
+def test_pair_combine_zero_operand_identity():
+    a = np.random.RandomState(1).randn(8).astype(np.float32)
+    z = np.zeros(8, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(A.pair_combine(jnp.asarray(a), jnp.asarray(z))), a,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(A.pair_combine(jnp.asarray(z), jnp.asarray(a))), a,
+        rtol=1e-6)
+
+
+def test_adasum_allreduce_pow2_matches_numpy_tree(hvd8):
+    rng = np.random.RandomState(3)
+    per_rank = rng.randn(N, 12).astype(np.float32)
+    out = run_spmd(hvd8, lambda x: A.adasum_allreduce(x),
+                   jnp.asarray(per_rank))
+    expected = np_adasum_tree(list(per_rank))
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected, rtol=1e-4)
+
+
+def test_adasum_allreduce_all_ranks_agree(hvd8):
+    rng = np.random.RandomState(4)
+    per_rank = rng.randn(N, 7, 3).astype(np.float32)
+    out = np.asarray(run_spmd(hvd8, lambda x: A.adasum_allreduce(x),
+                              jnp.asarray(per_rank)))
+    for r in range(1, N):
+        np.testing.assert_allclose(out[r], out[0], rtol=1e-6)
+
+
+def test_adasum_subset_members(hvd8):
+    rng = np.random.RandomState(5)
+    per_rank = rng.randn(N, 6).astype(np.float32)
+    members = (0, 2, 4)  # non-pow2 → gather+tree fallback with zero padding
+    out = run_spmd(
+        hvd8, lambda x: A.adasum_allreduce(x, members=members),
+        jnp.asarray(per_rank))
+    expected = np_adasum_tree([per_rank[0], per_rank[2], per_rank[4],
+                               np.zeros(6, np.float32)])
+    for r in members:
+        np.testing.assert_allclose(np.asarray(out[r]), expected, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out[1]), per_rank[1], rtol=1e-6)
+
+
+def test_adasum_via_reduce_op_dispatch(hvd8):
+    rng = np.random.RandomState(6)
+    per_rank = rng.randn(N, 10).astype(np.float32)
+    out = run_spmd(hvd8, lambda x: C.allreduce(x, C.Adasum),
+                   jnp.asarray(per_rank))
+    expected = np_adasum_tree(list(per_rank))
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4)
+
+
+def test_adasum_eager(hvd8):
+    rng = np.random.RandomState(7)
+    stacked = jnp.asarray(rng.randn(N, 9).astype(np.float32))
+    out = hvd8.allreduce(stacked, op=hvd.Adasum)
+    expected = np_adasum_tree(list(np.asarray(stacked)))
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4)

@@ -1,0 +1,80 @@
+"""Init/info API tests (reference: test/parallel/test_common.py and the
+horovod_rank/size C-API surface, operations.cc:932-1405)."""
+
+import jax
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_idempotent(hvd8):
+    assert hvd8.is_initialized()
+    hvd8.init()  # second call is a no-op
+    assert hvd8.is_initialized()
+
+
+def test_rank_size(hvd8):
+    assert hvd8.size() == 8
+    assert hvd8.rank() == 0
+    assert hvd8.local_size() == 8
+    assert hvd8.local_rank() == 0
+    assert hvd8.cross_size() == 1
+    assert hvd8.cross_rank() == 0
+    assert hvd8.num_slots() == 8
+    assert hvd8.is_homogeneous()
+
+
+def test_uninitialized_raises():
+    hvd.shutdown()
+    with pytest.raises(ValueError, match="initialized"):
+        hvd.rank()
+
+
+def test_mesh(hvd8):
+    mesh = hvd8.mesh()
+    assert mesh.shape[hvd8.mesh_axis()] == 8
+    assert hvd8.mesh_axis() == "hvd"
+
+
+def test_built_queries(hvd8):
+    assert hvd8.xla_built() and hvd8.xla_enabled()
+    assert not hvd8.mpi_built() and not hvd8.mpi_enabled()
+    assert not hvd8.nccl_built()
+    assert not hvd8.gloo_built()
+    assert not hvd8.cuda_built()
+    assert not hvd8.mpi_threads_supported()
+
+
+def test_process_set_crud(hvd8):
+    ps = hvd.add_process_set([0, 1, 2])
+    assert ps.process_set_id is not None and ps.process_set_id > 0
+    assert ps.size() == 3
+    assert ps.rank() == 0  # process rank 0 is member 0
+    assert ps.included()
+    # Identical set returns the existing registration (operations.cc:1262).
+    ps2 = hvd.add_process_set([2, 1, 0])
+    assert ps2.process_set_id == ps.process_set_id
+    ids = hvd.get_process_set_ids()
+    assert 0 in ids and ps.process_set_id in ids
+    assert hvd.remove_process_set(ps)
+    assert ps.process_set_id not in hvd.get_process_set_ids()
+
+
+def test_global_process_set_protected(hvd8):
+    assert not hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_process_set_excluded_rank(hvd8):
+    ps = hvd.ProcessSet([3, 4])
+    hvd.add_process_set(ps)
+    assert ps.rank() is None  # process rank 0 not a member
+    assert not ps.included()
+    assert ps.members() == (3, 4)
+    hvd.remove_process_set(ps)
+
+
+def test_process_set_validation(hvd8):
+    with pytest.raises(ValueError):
+        hvd.add_process_set([0, 99])
+    with pytest.raises(ValueError):
+        hvd.add_process_set([])

@@ -1,0 +1,103 @@
+"""Regression tests for review findings: subset scale restore, timeline
+module, duplicate-name detection, ragged allgatherv, homogeneity."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.topology import Topology
+from tests.test_collective_ops import run_spmd
+
+N = 8
+
+
+def test_subset_allreduce_nonmembers_get_unscaled_input(hvd8):
+    x = jnp.asarray(np.arange(N, dtype=np.float32).reshape(N, 1))
+    out = run_spmd(
+        hvd8,
+        lambda t: C.allreduce(t, C.Sum, members=(0, 1), prescale_factor=0.5),
+        x)
+    arr = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(out[0]), 0.5 * (arr[0] + arr[1]))
+    # Non-members must see their ORIGINAL value, not a prescaled one.
+    np.testing.assert_allclose(np.asarray(out[5]), arr[5])
+
+
+def test_timeline_writes_valid_chrome_trace(tmp_path, hvd8):
+    path = str(tmp_path / "timeline.json")
+    hvd8.start_timeline(path, mark_cycles=True)
+    x = jnp.ones((N, 4), jnp.float32)
+    hvd8.allreduce(x, name="allreduce.grad0")
+    hvd8.stop_timeline()
+    events = json.load(open(path))
+    names = {e["name"] for e in events}
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    assert "XLA_EXECUTE" in names
+    tids = {e.get("tid") for e in events}
+    assert "allreduce.grad0" in tids
+
+
+def test_timeline_env_knob_autostarts(tmp_path):
+    path = str(tmp_path / "auto_timeline.json")
+    os.environ["HOROVOD_TIMELINE"] = path
+    try:
+        hvd.shutdown()
+        hvd.init()
+        hvd.allreduce(jnp.ones((N, 2)), name="t")
+        hvd.shutdown()
+    finally:
+        del os.environ["HOROVOD_TIMELINE"]
+    events = json.load(open(path))
+    assert any(e["name"] == "ALLREDUCE" for e in events)
+
+
+def test_duplicate_name_error(hvd8):
+    from horovod_tpu.exceptions import DuplicateNameError
+    eng = hvd8.ops._engine()
+    eng.claim_name("dup")
+    with pytest.raises(DuplicateNameError):
+        hvd8.allreduce(jnp.ones((N, 2)), name="dup")
+    eng.release_name("dup")
+    hvd8.allreduce(jnp.ones((N, 2)), name="dup")  # released → fine again
+
+
+def test_allgatherv_ragged_emulated(hvd8):
+    rng = np.random.RandomState(0)
+    tensors = [jnp.asarray(rng.randn(r + 1, 2).astype(np.float32))
+               for r in range(N)]
+    outs = hvd8.allgather(tensors)
+    expected = np.concatenate([np.asarray(t) for t in tensors], axis=0)
+    assert expected.shape[0] == sum(range(1, N + 1))
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(outs[r]), expected, rtol=1e-6)
+
+
+def test_allgatherv_ragged_subset(hvd8):
+    rng = np.random.RandomState(1)
+    tensors = [jnp.asarray(rng.randn(r + 1, 2).astype(np.float32))
+               for r in range(N)]
+    ps = hvd.add_process_set([1, 3])
+    outs = hvd8.allgather(tensors, process_set=ps)
+    expected = np.concatenate([np.asarray(tensors[1]), np.asarray(tensors[3])],
+                              axis=0)
+    np.testing.assert_allclose(np.asarray(outs[1]), expected, rtol=1e-6)
+    # Non-member keeps own tensor.
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(tensors[0]))
+    hvd.remove_process_set(ps)
+
+
+def test_is_homogeneous_heterogeneous_layout():
+    t = Topology(rank=0, size=3, local_rank=0, local_size=1, cross_rank=0,
+                 cross_size=3, num_slots=6, local_slots=1,
+                 slots_per_node=[1, 2, 3])
+    assert not t.is_homogeneous
+    t2 = Topology(rank=0, size=3, local_rank=0, local_size=1, cross_rank=0,
+                  cross_size=3, num_slots=6, local_slots=2,
+                  slots_per_node=[2, 2, 2])
+    assert t2.is_homogeneous
