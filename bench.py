@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark — the reference's headline harness.
+
+Mirrors examples/pytorch/pytorch_synthetic_benchmark.py /
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py:25-80: ResNet-50,
+synthetic ImageNet-shaped data, batch 32 per accelerator, full training steps
+(forward + backward + DistributedOptimizer update), reports images/sec.
+
+Baseline: the reference's published absolute number is 1656.82 images/sec on
+16 Pascal GPUs (docs/benchmarks.rst:40-42) → 103.55 images/sec/GPU;
+``vs_baseline`` is images/sec-per-chip against that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import create_resnet50
+
+BATCH_PER_CHIP = 32
+WARMUP = 5
+ITERS = 30
+BASELINE_IMG_S_PER_DEV = 1656.82 / 16  # docs/benchmarks.rst:40-42
+
+
+def main():
+    hvd.init()
+    nslots = hvd.num_slots()
+    model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16, sync_bn=True)
+    rng = jax.random.PRNGKey(0)
+    batch = BATCH_PER_CHIP * nslots
+
+    images = jnp.asarray(
+        np.random.RandomState(0).rand(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, size=(batch,)))
+
+    # init outside shard_map: train=False avoids unbound-axis sync-BN stats
+    variables = model.init(rng, images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def local_step(params, batch_stats, opt_state, xb, yb):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        loss = hvd.allreduce(loss, op=hvd.Average)  # metric averaging
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    step = hvd.parallel.shard_step(
+        local_step,
+        in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1, 2))
+
+    # Warmup (includes compile).  Sync via host transfer: the steps form a
+    # dependency chain through params, so fetching the last loss forces every
+    # step to have executed (block_until_ready alone is unreliable through
+    # remote-execution PJRT transports).
+    for _ in range(WARMUP):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * ITERS / dt
+    per_dev = img_s / nslots
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(per_dev / BASELINE_IMG_S_PER_DEV, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

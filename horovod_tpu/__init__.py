@@ -45,6 +45,23 @@ from .ops import (  # noqa: F401
 
 from .compression import Compression  # noqa: F401
 
+from .optimizer import (  # noqa: F401
+    DistributedOptimizer, distributed_gradient_transformation,
+    adasum_delta_step, value_and_grad, grad,
+)
+
+from .functions import (  # noqa: F401
+    broadcast_variables, broadcast_parameters, broadcast_optimizer_state,
+    broadcast_object, broadcast_object_fn, allgather_object,
+)
+
+from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
+
+from . import parallel  # noqa: F401
+
+from . import runner  # noqa: F401
+run = runner.run  # launcher API (reference: horovod.run, runner/__init__.py:95)
+
 from .process_sets import (  # noqa: F401
     ProcessSet, global_process_set, add_process_set, remove_process_set,
     get_process_set_ids,

@@ -76,7 +76,8 @@ def _maybe_join_distributed(cfg: _config.Config) -> None:
     from jax._src import distributed as _jdist
     if getattr(_jdist.global_state, "client", None) is not None:
         return  # already initialized by the user
-    coordinator = f"{addr}:{int(port) + 1 if port else 9999}"
+    coordinator = os.environ.get(
+        "HVD_TPU_COORDINATOR", f"{addr}:{int(port) + 1 if port else 9999}")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(size),

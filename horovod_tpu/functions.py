@@ -1,0 +1,139 @@
+"""Parameter/object broadcast + allgather helpers.
+
+Reference: horovod/tensorflow/functions.py (broadcast_variables,
+broadcast_object, broadcast_object_fn, allgather_object — pickled objects
+shipped as uint8 tensors with a size side-channel) and
+horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state).  These are the checkpoint/startup
+synchronization standard: rank 0 restores, everyone else receives
+(SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core as _core
+from . import ops as _ops
+from .process_sets import ProcessSet, global_process_set
+
+
+def broadcast_variables(params, root_rank: int = 0,
+                        process_set: ProcessSet = global_process_set):
+    """Broadcast a pytree of arrays from ``root_rank``
+    (tensorflow/functions.py broadcast_variables; torch
+    broadcast_parameters).  Works in-trace or eagerly."""
+    # stacked=False: parameters are replicated values, never per-rank stacks —
+    # prevents the leading-dim heuristic from shredding a weight whose first
+    # dim equals the emulated rank count.
+    return jax.tree_util.tree_map(
+        lambda t: _ops.broadcast(t, root_rank=root_rank,
+                                 process_set=process_set, stacked=False),
+        params)
+
+
+# Horovod torch spelling.
+broadcast_parameters = broadcast_variables
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set: ProcessSet = global_process_set):
+    """Broadcast optimizer state (torch/functions.py
+    broadcast_optimizer_state).  optax states are pytrees of arrays +
+    static leaves; only array leaves are broadcast."""
+    def bc(leaf):
+        if isinstance(leaf, (jax.Array, np.ndarray)) or jnp.isscalar(leaf):
+            arr = jnp.asarray(leaf)
+            if arr.dtype == jnp.int32 and arr.ndim == 0:
+                # step counters etc. — broadcast as arrays too
+                pass
+            return _ops.broadcast(arr, root_rank=root_rank,
+                                  process_set=process_set, stacked=False)
+        return leaf
+
+    return jax.tree_util.tree_map(bc, opt_state)
+
+
+def _obj_to_u8(obj: Any) -> np.ndarray:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+
+
+def _u8_to_obj(arr: np.ndarray) -> Any:
+    return pickle.load(io.BytesIO(arr.tobytes()))
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: ProcessSet = global_process_set) -> Any:
+    """Pickle-broadcast an arbitrary Python object from root
+    (tensorflow/functions.py broadcast_object: object → uint8 tensor, size
+    broadcast first, then payload).
+
+    Emulated/single-rank modes return the object as-is (there is one Python
+    process — every "rank" already shares it).  Multi-process mode performs
+    the real size + payload broadcasts."""
+    topo = _core._require_init().topology
+    if topo.size == 1 or topo.emulated:
+        return obj
+    rank = _core.rank()
+    payload = _obj_to_u8(obj) if rank == root_rank else np.zeros(0, np.uint8)
+    sz = jnp.asarray([payload.size], jnp.int32)
+    sz = np.asarray(_ops.broadcast(sz, root_rank=root_rank,
+                                   process_set=process_set))
+    n = int(sz[0])
+    buf = np.zeros(n, np.uint8)
+    buf[:payload.size] = payload[:n] if rank == root_rank else 0
+    out = np.asarray(_ops.broadcast(jnp.asarray(buf), root_rank=root_rank,
+                                    process_set=process_set,
+                                    name=name))
+    return _u8_to_obj(out)
+
+
+def broadcast_object_fn(root_rank: int = 0, name: Optional[str] = None,
+                        process_set: ProcessSet = global_process_set):
+    """Returns a function broadcasting objects from root
+    (tensorflow/functions.py broadcast_object_fn)."""
+    def fn(obj=None):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+    return fn
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set: ProcessSet = global_process_set) -> list:
+    """Gather a Python object from every rank → list ordered by rank
+    (tensorflow/functions.py allgather_object: pickled uint8 + ragged
+    allgather).
+
+    Emulated/single-rank: the caller holds all "ranks'" objects — pass a list
+    of per-rank objects (emulated) or any object (single rank)."""
+    topo = _core._require_init().topology
+    if topo.size == 1:
+        return [obj]
+    if topo.emulated:
+        if not isinstance(obj, (list, tuple)) or len(obj) != topo.size:
+            raise ValueError(
+                f"emulated allgather_object takes a list of {topo.size} "
+                f"per-rank objects")
+        return list(obj)
+    payload = _obj_to_u8(obj)
+    out = _ops.allgather(jnp.asarray(payload)[:, None].astype(jnp.uint8),
+                         name=name, process_set=process_set)
+    # Ragged path returns the concatenation; we need per-rank boundaries.
+    sizes = np.asarray(_ops.allgather(
+        jnp.asarray([[payload.size]], jnp.int64), process_set=process_set)
+    ).ravel()
+    flat = np.asarray(out).ravel()
+    objs, off = [], 0
+    for s in sizes:
+        objs.append(_u8_to_obj(flat[off:off + int(s)]))
+        off += int(s)
+    return objs

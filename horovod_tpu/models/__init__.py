@@ -1,0 +1,11 @@
+"""Model zoo for benchmarks and examples.
+
+Mirrors the reference's benchmark surface (SURVEY.md §6): ResNet-50/101/152
+(tf_cnn_benchmarks / synthetic benchmark models), an MNIST-scale MLP/CNN
+(keras mnist examples), and transformer families (BERT-large / GPT-2) for the
+BASELINE.json north-star configs.
+"""
+
+from .resnet import (  # noqa: F401
+    ResNet, ResNet50, ResNet101, ResNet152, create_resnet50,
+)

@@ -1,0 +1,92 @@
+"""ResNet family (v1.5) in flax — the framework's flagship benchmark model.
+
+Reference analog: the reference benchmarks Horovod with tf_cnn_benchmarks /
+Keras applications ResNet-50 (docs/benchmarks.rst:27-43,
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py:25-80 uses
+``applications.ResNet50``).  The model itself is not reference code — this is
+a standard ResNet-v1.5 written TPU-first:
+
+* NHWC layout + channels padded to MXU-friendly multiples;
+* bfloat16 activations/weights with float32 batch-norm statistics and loss
+  (the canonical TPU mixed-precision recipe);
+* optional cross-rank synchronized batch norm via ``axis_name`` (the
+  hvd.SyncBatchNormalization analog, sync_batch_norm.py:22).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        # v1.5: stride on the 3x3, not the 1x1 (what tf_cnn_benchmarks runs).
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 use_bias=False, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None  # set to "hvd" for sync batch norm
+    block_cls: ModuleDef = BottleneckBlock
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype, padding="SAME")
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                       axis_name=self.axis_name if train else None)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i,
+                                   strides=strides, conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+
+
+def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
+                    sync_bn: bool = False):
+    return ResNet50(num_classes=num_classes, dtype=dtype,
+                    axis_name="hvd" if sync_bn else None)

@@ -300,9 +300,14 @@ def grouped_allgather_async(tensors, name=None,
 # ---------------------------------------------------------------------------
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
-              process_set: ProcessSet = global_process_set):
+              process_set: ProcessSet = global_process_set,
+              stacked: Optional[bool] = None):
     """Root's tensor to all participants (hvd.broadcast,
-    torch/mpi_ops.py:914)."""
+    torch/mpi_ops.py:914).
+
+    ``stacked`` (TPU-build extension, emulated mode only): declare whether
+    the tensor is a per-rank stack [N, ...] (True) or a replicated value
+    (False); None uses the leading-dim heuristic (see ops/eager.py)."""
     axis = _axis()
     members = _members(process_set)
     if _axis_bound(axis):
@@ -316,7 +321,7 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
         return [ts[0]]
 
     return eng.run("broadcast", body, [tensor], (root_rank, members),
-                   single, name=name)[0]
+                   single, name=name, stacked=stacked)[0]
 
 
 def broadcast_async(tensor, root_rank: int = 0, name=None,

@@ -141,15 +141,26 @@ class EagerEngine:
 
     # -- input normalization ------------------------------------------------
 
-    def _as_stacked(self, t: jax.Array) -> jax.Array:
-        """Emulated mode: tensors are per-rank stacks [N, ...]."""
+    def _as_stacked(self, t: jax.Array, stacked: Optional[bool] = None):
+        """Emulated mode input classification.
+
+        ``stacked=True``: the tensor is a per-rank stack [N, ...].
+        ``stacked=False``: the tensor is *replicated* (every rank passed the
+        same value — the broadcast_variables idiom) and is tiled.
+        ``stacked=None``: heuristic — leading dim == N means stacked.  The
+        heuristic misfires for a replicated tensor whose first dim happens to
+        equal N; callers that know the intent (functions.py helpers) pass the
+        flag explicitly.  Returns (stacked_tensor, was_stacked)."""
         t = jnp.asarray(t)
-        if t.ndim == 0 or t.shape[0] != self.n:
-            raise ValueError(
-                f"emulated-rank eager ops take stacked per-rank tensors with "
-                f"leading dim {self.n}; got shape {t.shape}. Wrap per-rank "
-                f"values with jnp.stack([...]).")
-        return t
+        if stacked is None:
+            stacked = t.ndim >= 1 and t.shape[0] == self.n
+        if stacked:
+            if t.ndim == 0 or t.shape[0] != self.n:
+                raise ValueError(
+                    f"stacked per-rank tensor must have leading dim "
+                    f"{self.n}; got shape {t.shape}")
+            return t, True
+        return jnp.broadcast_to(t[None], (self.n,) + t.shape), False
 
     def _to_global(self, t: jax.Array) -> jax.Array:
         """Multi-process mode: local [...] → global stacked [size, ...]."""
@@ -167,7 +178,8 @@ class EagerEngine:
 
     def run(self, kind: str, body, tensors: List[jax.Array],
             static_params: Tuple, single_rank_fn,
-            name: Optional[str] = None) -> List[jax.Array]:
+            name: Optional[str] = None,
+            stacked: Optional[bool] = None) -> List[jax.Array]:
         """Dispatch one eager collective; returns per-rank outputs
         (stacked in emulated mode, local otherwise).
 
@@ -189,7 +201,8 @@ class EagerEngine:
                     return [jnp.asarray(r) for r in single_rank_fn(
                         [jnp.asarray(t) for t in tensors])]
                 if self.topo.emulated:
-                    stacked = [self._as_stacked(t) for t in tensors]
+                    pairs = [self._as_stacked(t, stacked) for t in tensors]
+                    stacked = [p[0] for p in pairs]
                     if tl is None:
                         outs = self._stacked_run(kind, body, stacked,
                                                  static_params, self.mesh)
@@ -197,8 +210,18 @@ class EagerEngine:
                         with tl.activity(label, "XLA_EXECUTE"):
                             outs = self._stacked_run(kind, body, stacked,
                                                      static_params, self.mesh)
-                    return list(outs) if isinstance(outs, (tuple, list)) \
-                        else [outs]
+                    if not isinstance(outs, (tuple, list)):
+                        outs = [outs]
+                    # Replicated inputs to uniform-output collectives
+                    # (allreduce/allgather/broadcast/barrier produce the same
+                    # result on every rank) come back unstacked, so idioms
+                    # like broadcast_variables(params) round-trip shapes.
+                    uniform = kind in ("allreduce", "grouped_allreduce",
+                                       "allgather", "allgather_sizes",
+                                       "broadcast", "barrier")
+                    if uniform and not any(p[1] for p in pairs):
+                        return [o[0] for o in outs]
+                    return list(outs)
                 # Multi-process: global stacked arrays over per-process mesh.
                 mesh = self._multiproc_mesh()
                 global_ts = [self._to_global(t) for t in tensors]

@@ -1,0 +1,355 @@
+"""DistributedOptimizer / gradient-tape layer — Horovod's L6 on TPU.
+
+Reference surface being reproduced:
+
+* ``hvd.DistributedOptimizer(opt, backward_passes_per_step, compression,
+  op, gradient_predivide_factor, groups, process_set)`` — Torch:
+  horovod/torch/optimizer.py:36 (per-parameter hooks + async allreduce,
+  ``synchronize()`` waits handles, local aggregation when
+  backward_passes_per_step > 1); TF: horovod/tensorflow/__init__.py:896.
+* ``DistributedGradientTape`` — horovod/tensorflow/__init__.py:1125.
+* ``_DistributedAdasumOptimizer`` — horovod/torch/optimizer.py:345: applies
+  the optimizer locally to a parameter copy, Adasum-reduces the *delta*, adds
+  it back (Adasum must see post-optimizer deltas).
+
+TPU-native design: the optimizer layer is an **optax gradient
+transformation**, because under jit the "per-parameter hook + async handle"
+machinery is unnecessary — XLA's latency-hiding scheduler overlaps the psum
+with backward compute inside one fused step program, which is the same overlap
+Horovod engineers by hand with hooks (SURVEY.md §7 "Matching the NCCL
+baseline's overlap").  The transformation composes with any optax optimizer
+and runs identically:
+
+* inside ``jit``/``shard_map`` (axis bound) — grads reduce via ``lax.psum``;
+* eagerly — via the engine (ops/__init__.py dispatch).
+
+``backward_passes_per_step`` reproduces the reference's local gradient
+aggregation (tensorflow/gradient_aggregation.py:23,
+torch/optimizer.py:126): gradients accumulate locally for N steps; the
+allreduce happens only on the Nth, and the inner optimizer sees zero updates
+in between (optax.MultiSteps-style gating, implemented explicitly here so
+the allreduce sits at the aggregation boundary exactly like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import ops as _ops
+from .compression import Compression
+from .ops import ReduceOp
+from .process_sets import ProcessSet, global_process_set
+
+try:
+    import optax
+except ImportError:  # pragma: no cover - optax is baked into the image
+    optax = None
+
+
+def _axis_name() -> str:
+    from . import core as _core
+    return _core.mesh_axis() if _core.is_initialized() else "hvd"
+
+
+def _axis_bound(axis: str) -> bool:
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+
+
+def _is_invariant(x, axis: str) -> bool:
+    """True when ``x`` does not vary over the mesh axis (vma semantics):
+    under shard_map, gradients w.r.t. replicated parameters come back
+    *already psum'd* by the transpose rule, so they are axis-invariant."""
+    return axis not in getattr(jax.typeof(x), "vma", frozenset())
+
+
+def _to_varying(tree, axis: str):
+    """pcast every invariant leaf to varying — used to recover *local*
+    gradient semantics before an explicit Horovod-style allreduce."""
+    def cast(x):
+        if _is_invariant(x, axis):
+            return jax.lax.pcast(x, axis, to="varying")
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _reduce_grad_leaf(l, op, compression, prescale, postscale, process_set):
+    """Allreduce one gradient leaf with pre-summed-awareness.
+
+    In-trace, an axis-invariant gradient is one XLA already globally summed
+    (shard_map transpose of a replicated parameter).  For those: SUM is
+    complete, AVERAGE divides by the participant count — running a literal
+    psum would silently multiply by N.  Varying (local) gradients get the
+    normal collective.  This mirrors what the reference gets implicitly from
+    always seeing *local* gradients in framework hooks."""
+    axis = _axis_name()
+    if _axis_bound(axis) and _is_invariant(l, axis):
+        members = None if process_set is None or process_set.ranks is None \
+            else process_set.members()
+        n = len(members) if members is not None else jax.lax.axis_size(axis)
+        from .ops import collective_ops as C
+        l = C._apply_scale(l, prescale)
+        if op == ReduceOp.AVERAGE:
+            l = l / n
+        elif op != ReduceOp.SUM:
+            raise ValueError(
+                f"gradient leaf is axis-invariant (already reduced); only "
+                f"Sum/Average make sense, got {op!r}")
+        return C._apply_scale(l, postscale)
+    return _ops.allreduce(l, op=op, compression=compression,
+                          prescale_factor=prescale,
+                          postscale_factor=postscale,
+                          process_set=process_set)
+
+
+def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
+                    groups=None):
+    """Tree-map allreduce; ``groups`` (list of param-name buckets) reproduces
+    the reference's `groups` option (torch/optimizer.py grouped allreduce) —
+    under jit the grouping is advisory since XLA's combiner re-buckets, so we
+    lower each group through grouped_allreduce for eager parity."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if groups:
+        axis = _axis_name()
+        bound = _axis_bound(axis)
+        reduced = list(leaves)
+        import numpy as np
+        idx_groups = np.array_split(np.arange(len(leaves)), groups) \
+            if isinstance(groups, int) else groups
+        for g in idx_groups:
+            live = [i for i in g
+                    if not (bound and _is_invariant(leaves[i], axis))]
+            pre = [i for i in g if i not in set(live)]
+            for i in pre:  # already-reduced leaves: local rescale only
+                reduced[i] = _reduce_grad_leaf(
+                    leaves[i], op, compression, prescale, postscale,
+                    process_set)
+            if live:
+                out = _ops.grouped_allreduce(
+                    [leaves[i] for i in live], op=op, compression=compression,
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    process_set=process_set)
+                for i, o in zip(live, out):
+                    reduced[i] = o
+        return jax.tree_util.tree_unflatten(treedef, reduced)
+    reduced = [
+        _reduce_grad_leaf(l, op, compression, prescale, postscale,
+                          process_set)
+        for l in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+class DistributedState(NamedTuple):
+    inner_state: Any
+    acc_grads: Any        # local aggregation buffer (backward_passes_per_step)
+    counter: jax.Array    # passes since last sync
+
+
+def distributed_gradient_transformation(
+        op: ReduceOp = ReduceOp.AVERAGE,
+        compression=Compression.none,
+        gradient_predivide_factor: float = 1.0,
+        process_set: ProcessSet = global_process_set,
+        groups=None):
+    """The bare allreduce-gradients transformation (composable with any
+    optax chain).  Equivalent of wrapping compute_gradients
+    (tensorflow/__init__.py:896 DistributedOptimizer._compute_gradients).
+    Local gradient aggregation (``backward_passes_per_step``) lives in
+    ``DistributedOptimizer``, which gates the whole chain."""
+    if optax is None:
+        raise ImportError("optax is required for the optimizer layer")
+
+    # gradient_predivide_factor splits the averaging divide across pre/post
+    # scale (reference: torch/optimizer.py gradient_predivide_factor —
+    # prescale = 1/(factor*size) handled by the op layer when op=Average).
+    if gradient_predivide_factor != 1.0:
+        if op != ReduceOp.AVERAGE:
+            raise ValueError("gradient_predivide_factor supported only with "
+                             "op=Average (torch/optimizer.py:64)")
+        prescale = 1.0 / gradient_predivide_factor
+        postscale = gradient_predivide_factor
+    else:
+        prescale = postscale = 1.0
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        reduced = _allreduce_tree(updates, op, compression, prescale,
+                                  postscale, process_set, groups)
+        return reduced, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedOptimizer(optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = ReduceOp.AVERAGE,
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0,
+                         groups=None,
+                         process_set: ProcessSet = global_process_set):
+    """Wrap an optax optimizer with Horovod-style gradient reduction
+    (hvd.DistributedOptimizer, torch/optimizer.py:36 /
+    tensorflow/__init__.py:896).
+
+    Returns an optax GradientTransformation: ``update(grads, state, params)``
+    (1) accumulates grads locally for ``backward_passes_per_step`` passes,
+    (2) allreduces at the boundary (with compression / predivide / groups /
+    process set), (3) applies the wrapped optimizer.  Between boundaries the
+    parameter updates are zero, mirroring the reference where ``step()``
+    only synchronizes on aggregation boundaries (torch/optimizer.py:126).
+
+    ``named_parameters`` is accepted for API parity and ignored: JAX pytrees
+    carry structure, and under jit issue-order is program order so the
+    reference's name-based negotiation isn't needed (SURVEY.md §1 TPU note).
+
+    Adasum: pass ``op=hvd.Adasum``.  For SGD-family optimizers reducing the
+    gradient is equivalent to the reference's delta reduction
+    (_DistributedAdasumOptimizer, torch/optimizer.py:345: delta = lr*grad is
+    proportional to grad); for adaptive optimizers prefer reducing deltas
+    explicitly via ``adasum_delta_step``.
+    """
+    if optax is None:
+        raise ImportError("optax is required for the optimizer layer")
+    if num_groups and groups is None:
+        groups = num_groups
+    allreduce_t = distributed_gradient_transformation(
+        op=op, compression=compression,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set, groups=groups)
+    n = max(1, int(backward_passes_per_step))
+
+    if n == 1:
+        return optax.chain(allreduce_t, optimizer)
+
+    def init_fn(params):
+        return DistributedState(
+            inner_state=optimizer.init(params),
+            acc_grads=jax.tree_util.tree_map(jnp.zeros_like, params),
+            counter=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(updates, state, params=None):
+        acc = jax.tree_util.tree_map(lambda a, g: a + g,
+                                     state.acc_grads, updates)
+        counter = state.counter + 1
+        sync = counter >= n
+
+        # Under shard_map, branch outputs must agree on varying-manual-axes:
+        # the post-allreduce values are axis-invariant while local zeros are
+        # varying — pcast everything to varying for a consistent cond.
+        def _vary(tree):
+            from . import core as _core
+            axis = (_core.mesh_axis() if _core.is_initialized() else "hvd")
+            try:
+                jax.lax.axis_index(axis)
+            except NameError:
+                return tree  # eager: no manual axes in scope
+            def cast(x):
+                vma = getattr(jax.typeof(x), "vma", frozenset())
+                if axis in vma:
+                    return x  # already varying on this axis
+                return jax.lax.pcast(x, axis, to="varying")
+
+            return jax.tree_util.tree_map(cast, tree)
+
+        def do_sync(acc_and_state):
+            acc, inner_state = acc_and_state
+            # Average over the local passes like the reference's helper
+            # (gradient_aggregation.py averages by backward_passes_per_step).
+            scaled = jax.tree_util.tree_map(lambda a: a / n, acc)
+            reduced, _ = allreduce_t.update(scaled, optax.EmptyState(),
+                                            params)
+            new_updates, new_inner = optimizer.update(reduced, inner_state,
+                                                      params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return _vary((new_updates, new_inner, zeroed))
+
+        def no_sync(acc_and_state):
+            acc, inner_state = acc_and_state
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return _vary((zeros, inner_state, acc))
+
+        new_updates, new_inner, new_acc = jax.lax.cond(
+            sync, do_sync, no_sync, (acc, state.inner_state))
+        new_counter = jnp.where(sync, 0, counter)
+        return new_updates, DistributedState(new_inner, new_acc, new_counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adasum_delta_step(optimizer, params, grads, opt_state,
+                      process_set: ProcessSet = global_process_set):
+    """Adasum on post-optimizer deltas (_DistributedAdasumOptimizer,
+    torch/optimizer.py:345): apply the optimizer locally, Adasum-reduce the
+    parameter delta, add the reduced delta to the original parameters."""
+    local_updates, new_state = optimizer.update(grads, opt_state, params)
+    reduced_updates = jax.tree_util.tree_map(
+        lambda u: _ops.allreduce(u, op=ReduceOp.ADASUM,
+                                 process_set=process_set),
+        local_updates)
+    new_params = optax.apply_updates(params, reduced_updates) \
+        if optax is not None else jax.tree_util.tree_map(
+            lambda p, u: p + u, params, reduced_updates)
+    return new_params, new_state
+
+
+# ---------------------------------------------------------------------------
+# Gradient-tape style API (tensorflow/__init__.py:1125 DistributedGradientTape)
+# ---------------------------------------------------------------------------
+
+def value_and_grad(fun: Callable, *,
+                   op: ReduceOp = ReduceOp.AVERAGE,
+                   compression=Compression.none,
+                   process_set: ProcessSet = global_process_set,
+                   **jax_kwargs):
+    """``jax.value_and_grad`` whose gradients are allreduced — the
+    DistributedGradientTape analog (tensorflow/__init__.py:1125): every
+    rank computes its *local* gradient, the tape returns the combined one.
+
+    In-trace, differentiated arguments are pcast to varying first so the
+    gradient really is the local one (otherwise shard_map's transpose rule
+    pre-sums gradients of replicated primals and the explicit allreduce
+    would double-count)."""
+    vg = jax.value_and_grad(fun, **jax_kwargs)
+
+    def wrapped(*args, **kwargs):
+        axis = _axis_name()
+        if _axis_bound(axis):
+            args = _to_varying(args, axis)
+        val, grads = vg(*args, **kwargs)
+        grads = _allreduce_tree(grads, op, compression, 1.0, 1.0, process_set)
+        return val, grads
+
+    return wrapped
+
+
+def grad(fun: Callable, *,
+         op: ReduceOp = ReduceOp.AVERAGE,
+         compression=Compression.none,
+         process_set: ProcessSet = global_process_set,
+         **jax_kwargs):
+    """``jax.grad`` with allreduced local gradients (see value_and_grad)."""
+    g = jax.grad(fun, **jax_kwargs)
+
+    def wrapped(*args, **kwargs):
+        axis = _axis_name()
+        if _axis_bound(axis):
+            args = _to_varying(args, axis)
+        grads = g(*args, **kwargs)
+        return _allreduce_tree(grads, op, compression, 1.0, 1.0, process_set)
+
+    return wrapped

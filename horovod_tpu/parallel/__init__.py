@@ -1,0 +1,106 @@
+"""Parallelism utilities: meshes, SPMD step wrappers, hierarchical layouts.
+
+This package goes beyond the reference's data-parallel scope the TPU-native
+way: the same device mesh that carries Horovod-style allreduce also carries
+tensor/sequence/expert shardings via pjit specs (SURVEY.md §2.3 marks TP/PP/
+SP/EP "not in reference scope" but the mesh design gets them cheaply).
+Submodules:
+
+* (here)      — mesh construction + ``shard_step`` SPMD wrapper
+* ring        — ring attention over ``ppermute`` (long-context SP/CP)
+* ulysses     — all-to-all sequence↔head parallelism (DeepSpeed-Ulysses style)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import core as _core
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """Build an N-D mesh from axis name→size, e.g. {"cross": 4, "hvd": 8}.
+
+    The 2-D (cross, local) layout is the ICI-native analog of the reference's
+    NCCLTorusAllreduce local/cross communicator decomposition
+    (nccl_operations.h:253): XLA maps the inner axis onto torus neighbors so
+    reductions ride the physical links."""
+    if devices is None:
+        devices = _core.mesh().devices.flatten() if _core.is_initialized() \
+            else np.asarray(jax.devices())
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    total = int(np.prod(sizes))
+    devices = np.asarray(devices).flatten()
+    if total != devices.size:
+        raise ValueError(f"mesh {axis_sizes} needs {total} devices, "
+                         f"have {devices.size}")
+    return Mesh(devices.reshape(sizes), names)
+
+
+def hierarchical_mesh() -> Mesh:
+    """(cross, local) mesh from the detected topology — HOROVOD_HIERARCHICAL_
+    ALLREDUCE / HOROVOD_TORUS_ALLREDUCE analog (operations.cc:553-605):
+    'local' spans chips on one host, 'cross' spans hosts."""
+    st = _core._require_init()
+    topo = st.topology
+    local = topo.local_slots
+    cross = max(1, topo.num_slots // max(local, 1))
+    return make_mesh({"cross": cross, "local": local})
+
+
+def shard_step(fn: Callable,
+               *,
+               mesh: Optional[Mesh] = None,
+               in_specs=None,
+               out_specs=None,
+               axis_name: Optional[str] = None,
+               donate_argnums: Tuple[int, ...] = (),
+               ) -> Callable:
+    """jit(shard_map(fn)) over the framework mesh — the SPMD step wrapper.
+
+    ``fn`` is the per-slot step (sees local shards; calls hvd collectives
+    in-trace).  Default specs: first argument replicated (params), the rest
+    sharded on dim 0 over the mesh axis (batches) — the data-parallel layout
+    of every reference example (examples/tensorflow2/
+    tensorflow2_synthetic_benchmark.py)."""
+    mesh = mesh or _core.mesh()
+    axis = axis_name or (_core.mesh_axis() if _core.is_initialized()
+                         else "hvd")
+
+    def build(nargs: int):
+        ins = in_specs
+        if ins is None:
+            ins = (P(),) + tuple(P(axis) for _ in range(nargs - 1))
+        outs = out_specs if out_specs is not None else P()
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=ins, out_specs=outs)
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    cache = {}
+
+    def wrapper(*args):
+        key = len(args)
+        if key not in cache:
+            cache[key] = build(key)
+        return cache[key](*args)
+
+    return wrapper
+
+
+def data_parallel_sharding(mesh: Optional[Mesh] = None,
+                           axis_name: Optional[str] = None) -> NamedSharding:
+    """NamedSharding splitting dim 0 over the mesh axis — for device_put of
+    global batches."""
+    mesh = mesh or _core.mesh()
+    axis = axis_name or _core.mesh_axis()
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or _core.mesh()
+    return NamedSharding(mesh, P())

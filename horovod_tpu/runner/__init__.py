@@ -1,0 +1,87 @@
+"""In-process launcher API: ``horovod_tpu.run(func, np=...)``.
+
+Reference: horovod/runner/__init__.py:95 ``horovod.run`` — pickles the
+function (cloudpickle), launches workers, ships the function via the
+rendezvous KV store, gathers per-rank return values.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from .launch import parse_args, _run_static
+
+
+def run(func: Callable,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        np: int = 1,
+        hosts: Optional[str] = None,
+        hostfile: Optional[str] = None,
+        start_timeout: Optional[int] = None,
+        ssh_port: Optional[int] = None,
+        ssh_identity_file: Optional[str] = None,
+        verbose: bool = False,
+        use_gloo: Optional[bool] = None,
+        use_mpi: Optional[bool] = None,
+        network_interface: Optional[str] = None) -> List[Any]:
+    """Run ``func(*args, **kwargs)`` on ``np`` ranks and return the list of
+    per-rank results ordered by rank (horovod.run, runner/__init__.py:95).
+
+    The function is cloudpickled to a temp file, each worker executes a
+    bootstrap that initializes the runtime, calls it, and writes its result
+    to ``result_<rank>.pkl``; the launcher collects them.
+    """
+    import cloudpickle
+    from . import hosts as _hosts_mod
+    from .launch import _is_local
+    if hosts:
+        remote = [h.hostname for h in _hosts_mod.parse_hosts(hosts)
+                  if not _is_local(h.hostname)]
+        if remote:
+            raise NotImplementedError(
+                f"horovod_tpu.run() currently gathers results through a "
+                f"local temp dir and cannot collect from remote hosts "
+                f"{remote}; use the horovodrun CLI with a shared filesystem "
+                f"instead")
+    kwargs = kwargs or {}
+    workdir = tempfile.mkdtemp(prefix="hvd_tpu_run_")
+    fn_path = os.path.join(workdir, "func.pkl")
+    with open(fn_path, "wb") as f:
+        cloudpickle.dump((func, args, kwargs), f)
+
+    bootstrap = (
+        "import pickle, os, sys; "
+        f"sys.path.insert(0, {os.getcwd()!r}); "
+        f"fn, a, kw = pickle.load(open({fn_path!r}, 'rb')); "
+        "r = fn(*a, **kw); "
+        "rank = int(os.environ.get('HOROVOD_RANK', 0)); "
+        f"pickle.dump(r, open(os.path.join({workdir!r}, "
+        "f'result_{rank}.pkl'), 'wb'))"
+    )
+    argv = ["-np", str(np)]
+    if hosts:
+        argv += ["-H", hosts]
+    if hostfile:
+        argv += ["--hostfile", hostfile]
+    if ssh_port:
+        argv += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        argv += ["-i", ssh_identity_file]
+    if verbose:
+        argv += ["--verbose"]
+    argv += [sys.executable, "-c", bootstrap]
+    parsed = parse_args(argv)
+    ret = _run_static(parsed)
+    if ret != 0:
+        raise RuntimeError(f"horovod_tpu.run failed with exit code {ret}")
+    results = []
+    for rank in range(np):
+        path = os.path.join(workdir, f"result_{rank}.pkl")
+        with open(path, "rb") as f:
+            results.append(pickle.load(f))
+    return results

@@ -1,0 +1,143 @@
+"""HTTP KV store + rendezvous server.
+
+Reference: horovod/runner/http/http_server.py:35 (KVStoreHandler: PUT/GET
+scoped key-value store), :152 (RendezvousHandler), :192 (RendezvousServer:
+publishes the host allocation plan that workers read to discover their slot
+info).  The Gloo context reads `HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT` to find it
+(common/gloo/gloo_context.h:28-42).
+
+TPU build role: the same rendezvous pattern bootstraps (a) worker env
+validation, (b) `jax.distributed` coordinator discovery, and (c) the elastic
+driver's dynamic slot info (elastic rendezvous returns per-(host,local_rank)
+records that change across resets).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..utils import get_logger
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    """Scoped KV store over PUT/GET (http_server.py:35 KVStoreHandler)."""
+
+    def log_message(self, fmt, *args):  # silence default stderr spam
+        get_logger().debug("kvstore: " + fmt % args)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.cache_lock:
+            scope_dict = self.server.cache.setdefault(self._scope(), {})
+            scope_dict[self._key()] = value
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.server.cache_lock:
+            value = self.server.cache.get(self._scope(), {}).get(self._key())
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        with self.server.cache_lock:
+            self.server.cache.get(self._scope(), {}).pop(self._key(), None)
+        self.send_response(200)
+        self.end_headers()
+
+    def _scope(self) -> str:
+        parts = self.path.strip("/").split("/")
+        return parts[0] if parts else ""
+
+    def _key(self) -> str:
+        parts = self.path.strip("/").split("/")
+        return "/".join(parts[1:]) if len(parts) > 1 else ""
+
+
+class KVStoreServer:
+    """Threaded KV server (RendezvousServer base, http_server.py:192)."""
+
+    def __init__(self, verbose: bool = False):
+        self.httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, port: int = 0) -> int:
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self.httpd.cache = {}
+        self.httpd.cache_lock = threading.Lock()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="hvd-kvstore")
+        self._thread.start()
+        return self.httpd.server_address[1]
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def put(self, scope: str, key: str, value: bytes):
+        with self.httpd.cache_lock:
+            self.httpd.cache.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self.httpd.cache_lock:
+            return self.httpd.cache.get(scope, {}).get(key)
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+
+
+class RendezvousServer(KVStoreServer):
+    """Publishes the host allocation plan (http_server.py:192
+    RendezvousServer.init)."""
+
+    SCOPE = "rendezvous"
+
+    def init(self, host_alloc_plan) -> None:
+        """host_alloc_plan: list of SlotInfo (runner/hosts.py).  Keys are
+        published both by rank and by (hostname, local_rank) like the
+        reference's elastic handler."""
+        for slot in host_alloc_plan:
+            payload = json.dumps(slot.to_dict()).encode()
+            self.put(self.SCOPE, f"rank/{slot.rank}", payload)
+            self.put(self.SCOPE,
+                     f"slot/{slot.hostname}/{slot.local_rank}", payload)
+        self.put(self.SCOPE, "size",
+                 str(len(host_alloc_plan)).encode())
+
+
+class KVStoreClient:
+    """Worker-side client (runner/http/http_client.py analog)."""
+
+    def __init__(self, addr: str, port: int):
+        self.base = f"http://{addr}:{port}"
+
+    def put(self, scope: str, key: str, value: bytes):
+        import urllib.request
+        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
+                                     data=value, method="PUT")
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        import urllib.request
+        import urllib.error
+        try:
+            return urllib.request.urlopen(
+                f"{self.base}/{scope}/{key}", timeout=30).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise

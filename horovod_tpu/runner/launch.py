@@ -1,0 +1,387 @@
+"""``horovodrun`` — the launcher CLI.
+
+Reference: horovod/runner/launch.py:286 (parse_args: every knob as a flag
+writing ``HOROVOD_*`` env), :594 (_run_static), :689 (_run_elastic), :747
+(run_controller choosing gloo/mpi/jsrun), plus the YAML ``--config-file``
+layer (runner/common/util/config_parser.py).
+
+TPU build: one launch path — spawn one worker process per slot with
+rendezvous env injected (gloo_run.py:66-78 analog), local slots via
+subprocess, remote hosts via ssh.  The legacy backend selectors
+(--gloo/--mpi) are accepted for compatibility and ignored: there is exactly
+one backend (XLA collectives).  ``jax.distributed`` coordinator bootstrap
+replaces MPI_Init (core.py _maybe_join_distributed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from .. import config as _config
+from ..version import __version__
+from . import hosts as _hosts
+from . import safe_shell_exec
+from .http_server import RendezvousServer
+
+
+def make_override_action(override_args):
+    """argparse action that records explicitly-set flags
+    (launch.py:158 make_override_action)."""
+    class StoreOverrideAction(argparse.Action):
+        def __call__(self, parser, namespace, values, option_string=None):
+            override_args.add(self.dest)
+            setattr(namespace, self.dest, values)
+
+    class StoreTrueOverrideAction(argparse.Action):
+        def __init__(self, option_strings, dest, nargs=0, **kwargs):
+            super().__init__(option_strings, dest, nargs=0, **kwargs)
+
+        def __call__(self, parser, namespace, values, option_string=None):
+            override_args.add(self.dest)
+            setattr(namespace, self.dest, True)
+
+    return StoreOverrideAction, StoreTrueOverrideAction
+
+
+def parse_args(argv=None):
+    """Flag surface mirroring runner/launch.py:286-578."""
+    override_args = set()
+    Store, StoreTrue = make_override_action(override_args)
+
+    parser = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Horovod-compatible launcher for the TPU-native runtime.")
+    parser.add_argument("-v", "--version", action="version",
+                        version=__version__)
+    parser.add_argument("-np", "--num-proc", dest="np", type=int,
+                        help="Total number of training processes.")
+    parser.add_argument("-p", "--ssh-port", dest="ssh_port", type=int,
+                        help="SSH port on all hosts.")
+    parser.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file",
+                        help="SSH identity (private key) file.")
+    parser.add_argument("--network-interface", dest="nics",
+                        help="Comma-separated network interfaces to use.")
+    parser.add_argument("--output-filename", dest="output_filename",
+                        help="Per-rank output redirection directory.")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--config-file", dest="config_file",
+                        help="YAML config file (launch.py --config-file).")
+    parser.add_argument("--disable-cache", action=StoreTrue,
+                        dest="disable_cache",
+                        help="Disable the response cache.")
+    parser.add_argument("--start-timeout", dest="start_timeout", type=int,
+                        default=600)
+
+    group_host = parser.add_argument_group("host arguments")
+    group_host.add_argument("-H", "--hosts", dest="hosts",
+                            help='Host list, e.g. "h1:4,h2:4".')
+    group_host.add_argument("-hostfile", "--hostfile", dest="hostfile",
+                            help='Hostfile with "hostname slots=N" lines.')
+
+    group_controller = parser.add_mutually_exclusive_group()
+    group_controller.add_argument("--gloo", "--use-gloo", dest="use_gloo",
+                                  action="store_true",
+                                  help="Compatibility no-op (single backend).")
+    group_controller.add_argument("--mpi", "--use-mpi", dest="use_mpi",
+                                  action="store_true",
+                                  help="Compatibility no-op (single backend).")
+
+    group_params = parser.add_argument_group("tuneable parameter arguments")
+    group_params.add_argument("--fusion-threshold-mb", action=Store,
+                              type=int, dest="fusion_threshold_mb",
+                              help="Fusion buffer threshold in MB.")
+    group_params.add_argument("--cycle-time-ms", action=Store, type=float,
+                              dest="cycle_time_ms")
+    group_params.add_argument("--cache-capacity", action=Store, type=int,
+                              dest="cache_capacity")
+    group_params.add_argument("--hierarchical-allreduce", action=StoreTrue,
+                              dest="hierarchical_allreduce")
+    group_params.add_argument("--hierarchical-allgather", action=StoreTrue,
+                              dest="hierarchical_allgather")
+
+    group_autotune = parser.add_argument_group("autotune arguments")
+    group_autotune.add_argument("--autotune", action=StoreTrue,
+                                dest="autotune")
+    group_autotune.add_argument("--autotune-log-file", action=Store,
+                                dest="autotune_log_file")
+
+    group_timeline = parser.add_argument_group("timeline arguments")
+    group_timeline.add_argument("--timeline-filename", action=Store,
+                                dest="timeline_filename")
+    group_timeline.add_argument("--timeline-mark-cycles", action=StoreTrue,
+                                dest="timeline_mark_cycles")
+
+    group_stall = parser.add_argument_group("stall check arguments")
+    group_stall.add_argument("--no-stall-check", action=StoreTrue,
+                             dest="no_stall_check")
+    group_stall.add_argument("--stall-check-warning-time-seconds",
+                             action=Store, type=int,
+                             dest="stall_check_warning_time_seconds")
+    group_stall.add_argument("--stall-check-shutdown-time-seconds",
+                             action=Store, type=int,
+                             dest="stall_check_shutdown_time_seconds")
+
+    group_library = parser.add_argument_group("library arguments")
+    group_library.add_argument("--mpi-threads-disable", action=StoreTrue,
+                               dest="mpi_threads_disable",
+                               help="Compatibility no-op.")
+    group_library.add_argument("--num-nccl-streams", action=Store, type=int,
+                               dest="num_nccl_streams",
+                               help="Compatibility no-op.")
+    group_library.add_argument("--thread-affinity", action=Store, type=int,
+                               dest="thread_affinity")
+
+    group_logging = parser.add_argument_group("logging arguments")
+    group_logging.add_argument("--log-level", action=Store,
+                               dest="log_level",
+                               choices=["TRACE", "DEBUG", "INFO", "WARNING",
+                                        "ERROR", "FATAL"])
+    group_logging.add_argument("--log-with-timestamp", action=StoreTrue,
+                               dest="log_with_timestamp")
+    group_logging.add_argument("--log-hide-timestamp", action=StoreTrue,
+                               dest="log_hide_timestamp")
+    group_logging.add_argument("--prefix-output-with-timestamp",
+                               action="store_true",
+                               dest="prefix_output_with_timestamp")
+
+    group_elastic = parser.add_argument_group("elastic arguments")
+    group_elastic.add_argument("--min-np", "--min-num-proc", type=int,
+                               dest="min_np")
+    group_elastic.add_argument("--max-np", "--max-num-proc", type=int,
+                               dest="max_np")
+    group_elastic.add_argument("--slots", type=int, dest="slots",
+                               help="Slots per host for elastic discovery.")
+    group_elastic.add_argument("--host-discovery-script",
+                               dest="host_discovery_script")
+    group_elastic.add_argument("--reset-limit", type=int, dest="reset_limit")
+    group_elastic.add_argument("--blacklist-cooldown-range", type=int,
+                               nargs=2, dest="blacklist_cooldown_range")
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Command to run on each rank.")
+
+    args = parser.parse_args(argv)
+    args.override_args = override_args
+    if args.config_file:
+        _apply_config_file(args)
+    return args
+
+
+def _apply_config_file(args):
+    """YAML config → args, CLI flags win (config_parser.py precedence)."""
+    import yaml
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    mapping = {
+        "fusion_threshold_mb": "fusion-threshold-mb",
+        "cycle_time_ms": "cycle-time-ms",
+        "cache_capacity": "cache-capacity",
+        "hierarchical_allreduce": "hierarchical-allreduce",
+        "hierarchical_allgather": "hierarchical-allgather",
+        "autotune": "autotune",
+        "autotune_log_file": "autotune-log-file",
+        "timeline_filename": "timeline-filename",
+        "timeline_mark_cycles": "timeline-mark-cycles",
+        "no_stall_check": "no-stall-check",
+        "stall_check_warning_time_seconds":
+            "stall-check-warning-time-seconds",
+        "stall_check_shutdown_time_seconds":
+            "stall-check-shutdown-time-seconds",
+        "log_level": "log-level",
+    }
+    flat = {}
+    for section in cfg.values() if isinstance(cfg, dict) else []:
+        if isinstance(section, dict):
+            flat.update(section)
+    if isinstance(cfg, dict):
+        flat.update({k: v for k, v in cfg.items()
+                     if not isinstance(v, dict)})
+    for dest, yaml_key in mapping.items():
+        if dest in args.override_args:
+            continue  # CLI beats config file
+        for k in (yaml_key, dest):
+            if k in flat:
+                setattr(args, dest, flat[k])
+                break
+
+
+def env_from_args(args) -> Dict[str, str]:
+    """Flags → HOROVOD_* env (launch.py + config_parser.set_env_from_args)."""
+    env = {}
+    if getattr(args, "fusion_threshold_mb", None) is not None:
+        env[_config.HOROVOD_FUSION_THRESHOLD] = str(
+            args.fusion_threshold_mb * 1024 * 1024)
+    if getattr(args, "cycle_time_ms", None) is not None:
+        env[_config.HOROVOD_CYCLE_TIME] = str(args.cycle_time_ms)
+    if getattr(args, "cache_capacity", None) is not None:
+        env[_config.HOROVOD_CACHE_CAPACITY] = str(args.cache_capacity)
+    if getattr(args, "disable_cache", None):
+        env[_config.HOROVOD_CACHE_CAPACITY] = "0"
+    if getattr(args, "hierarchical_allreduce", None):
+        env[_config.HOROVOD_HIERARCHICAL_ALLREDUCE] = "1"
+    if getattr(args, "hierarchical_allgather", None):
+        env[_config.HOROVOD_HIERARCHICAL_ALLGATHER] = "1"
+    if getattr(args, "autotune", None):
+        env[_config.HOROVOD_AUTOTUNE] = "1"
+    if getattr(args, "autotune_log_file", None):
+        env[_config.HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
+    if getattr(args, "timeline_filename", None):
+        env[_config.HOROVOD_TIMELINE] = args.timeline_filename
+    if getattr(args, "timeline_mark_cycles", None):
+        env[_config.HOROVOD_TIMELINE_MARK_CYCLES] = "1"
+    if getattr(args, "no_stall_check", None):
+        env[_config.HOROVOD_STALL_CHECK_DISABLE] = "1"
+    if getattr(args, "stall_check_warning_time_seconds", None) is not None:
+        env[_config.HOROVOD_STALL_CHECK_TIME_SECONDS] = str(
+            args.stall_check_warning_time_seconds)
+    if getattr(args, "stall_check_shutdown_time_seconds", None) is not None:
+        env[_config.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS] = str(
+            args.stall_check_shutdown_time_seconds)
+    if getattr(args, "log_level", None):
+        env[_config.HOROVOD_LOG_LEVEL] = args.log_level.lower()
+    if getattr(args, "log_hide_timestamp", None):
+        env[_config.HOROVOD_LOG_HIDE_TIME] = "1"
+    return env
+
+
+def _worker_env(base_env: Dict[str, str], slot: _hosts.SlotInfo,
+                rendezvous_addr: str, rendezvous_port: int,
+                coordinator: str) -> Dict[str, str]:
+    """Per-slot rendezvous env (gloo_run.py:66-78)."""
+    env = dict(base_env)
+    env.update({
+        _config.HOROVOD_RANK: str(slot.rank),
+        _config.HOROVOD_SIZE: str(slot.size),
+        _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
+        _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
+        _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
+        _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
+        _config.HOROVOD_HOSTNAME: slot.hostname,
+        _config.HOROVOD_RENDEZVOUS_ADDR: rendezvous_addr,
+        _config.HOROVOD_RENDEZVOUS_PORT: str(rendezvous_port),
+        "HVD_TPU_COORDINATOR": coordinator,
+    })
+    return env
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def _ssh_command(slot: _hosts.SlotInfo, command: List[str],
+                 env: Dict[str, str], args) -> List[str]:
+    """Remote launch line (gloo_run.py get_remote_command analog)."""
+    import shlex
+    exports = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+                       if k.startswith(("HOROVOD_", "HVD_TPU_", "PATH",
+                                        "PYTHONPATH")))
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if args.ssh_port:
+        ssh += ["-p", str(args.ssh_port)]
+    if args.ssh_identity_file:
+        ssh += ["-i", args.ssh_identity_file]
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    return ssh + [slot.hostname, remote]
+
+
+def _run_static(args) -> int:
+    """Static (fixed world) launch (launch.py:594 _run_static)."""
+    if args.hostfile:
+        host_list = _hosts.parse_host_files(args.hostfile)
+    elif args.hosts:
+        host_list = _hosts.parse_hosts(args.hosts)
+    else:
+        np_ = args.np or 1
+        host_list = [_hosts.HostInfo("localhost", np_)]
+    np_ = args.np or sum(h.slots for h in host_list)
+    assignments = _hosts.get_host_assignments(host_list, np_)
+
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    port = rendezvous.start()
+    rendezvous.init(assignments)
+    has_remote = any(not _is_local(h.hostname) for h in host_list)
+    addr = socket.gethostbyname(socket.gethostname()) if has_remote \
+        else "127.0.0.1"
+    # The jax.distributed coordinator runs inside rank 0's process.  With any
+    # remote worker in the job, loopback would point remote workers at
+    # themselves — use a routable name for rank 0's host instead.
+    coord_host = assignments[0].hostname
+    if _is_local(coord_host):
+        coord_addr = addr  # routable self-address when remotes exist
+    else:
+        coord_addr = coord_host
+    coordinator = f"{coord_addr}:{int(os.environ.get('HVD_TPU_COORD_PORT', 29400))}"
+
+    base_env = {k: v for k, v in os.environ.items()}
+    base_env.update(env_from_args(args))
+
+    threads = []
+    rets = [None] * len(assignments)
+    failure = threading.Event()
+
+    def run_slot(i: int, slot: _hosts.SlotInfo):
+        try:
+            env = _worker_env(base_env, slot, addr, port, coordinator)
+            prefix = f"[{slot.rank}]<stdout>:" if len(assignments) > 1 else ""
+            if _is_local(slot.hostname):
+                cmd = args.command
+            else:
+                cmd = _ssh_command(slot, args.command, env, args)
+            rets[i] = safe_shell_exec.execute(
+                cmd, env=env, prefix=prefix,
+                prefix_timestamp=args.prefix_output_with_timestamp,
+                events=[failure])
+        except Exception as e:  # spawn failure must count as rank failure
+            print(f"horovodrun: rank {slot.rank} failed to launch: {e}",
+                  file=sys.stderr)
+            rets[i] = 1
+        if rets[i] != 0:
+            failure.set()
+
+    try:
+        for i, slot in enumerate(assignments):
+            t = threading.Thread(target=run_slot, args=(i, slot), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    finally:
+        rendezvous.stop()
+    bad = [(assignments[i].rank, r) for i, r in enumerate(rets) if r]
+    if bad:
+        print(f"horovodrun: ranks failed: {bad}", file=sys.stderr)
+        return bad[0][1] or 1
+    return 0
+
+
+def _run_elastic(args) -> int:
+    """Elastic launch (launch.py:689): delegate to the elastic driver."""
+    from ..elastic.driver import launch_elastic
+    return launch_elastic(args)
+
+
+def _run(args) -> int:
+    if not args.command:
+        print("horovodrun: no command given; see --help", file=sys.stderr)
+        return 2
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    elastic = args.host_discovery_script is not None or \
+        (args.min_np is not None and args.min_np != (args.max_np or args.min_np))
+    if elastic:
+        return _run_elastic(args)
+    return _run_static(args)
+
+
+def run_commandline(argv=None) -> None:
+    sys.exit(_run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    run_commandline()

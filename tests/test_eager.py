@@ -144,9 +144,15 @@ def test_eager_barrier_and_join(hvd8):
     assert hvd8.join() == N - 1
 
 
-def test_eager_bad_stack_shape(hvd8):
-    with pytest.raises(ValueError, match="stacked"):
-        hvd8.allreduce(jnp.ones((3, 2)))  # leading dim != 8
+def test_eager_replicated_input_unstacked_output(hvd8):
+    # Leading dim != 8 → treated as "same value on every rank"
+    # (broadcast_variables idiom); uniform-output ops return it unstacked.
+    x = jnp.asarray(np.random.RandomState(3).randn(3, 2).astype(np.float32))
+    out = hvd8.allreduce(x, op=hvd.Sum)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(out, 8 * np.asarray(x), rtol=1e-5)
+    out = hvd8.broadcast(x, root_rank=4)
+    np.testing.assert_allclose(out, np.asarray(x), rtol=1e-6)
 
 
 def test_exec_cache_reuse(hvd8, stacked):

@@ -1,0 +1,224 @@
+"""DistributedOptimizer / gradient layer tests (reference:
+test/parallel/test_torch.py optimizer sections + gradient_aggregation tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from tests.test_collective_ops import run_spmd
+
+N = 8
+
+
+def test_distributed_optimizer_averages_gradients(hvd8):
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    params = {"w": jnp.zeros((3,), jnp.float32)}
+    per_rank_grads = jnp.asarray(
+        np.random.RandomState(0).randn(N, 3).astype(np.float32))
+
+    def body(g):
+        state = opt.init(params)
+        updates, _ = opt.update({"w": g}, state, params)
+        return updates["w"]
+
+    out = run_spmd(hvd8, body, per_rank_grads)
+    expected = -np.mean(np.asarray(per_rank_grads), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected, rtol=1e-5)
+
+
+def test_distributed_optimizer_sum_and_predivide(hvd8):
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                   gradient_predivide_factor=2.0)
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    g = jnp.asarray(np.random.RandomState(1).randn(N, 4).astype(np.float32))
+
+    def body(gr):
+        state = opt.init(params)
+        updates, _ = opt.update({"w": gr}, state, params)
+        return updates["w"]
+
+    out = run_spmd(hvd8, body, g)
+    # predivide 2: prescale 1/2, average, postscale 2 → same as plain average.
+    expected = -np.mean(np.asarray(g), axis=0)
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4)
+
+
+def test_backward_passes_per_step_accumulates(hvd8):
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=2)
+    params = {"w": jnp.zeros((2,), jnp.float32)}
+    g1 = jnp.asarray(np.random.RandomState(2).randn(N, 2).astype(np.float32))
+    g2 = jnp.asarray(np.random.RandomState(3).randn(N, 2).astype(np.float32))
+
+    def body(a, b):
+        state = opt.init(params)
+        u1, state = opt.update({"w": a}, state, params)
+        u2, state = opt.update({"w": b}, state, params)
+        return u1["w"], u2["w"]
+
+    u1, u2 = run_spmd(hvd8, body, g1, g2)
+    # First pass: zero update (aggregation only).
+    np.testing.assert_allclose(np.asarray(u1[0]), np.zeros(2), atol=1e-7)
+    # Second: mean over ranks of (g1+g2)/2, negated by sgd(1.0).
+    expected = -np.mean((np.asarray(g1) + np.asarray(g2)) / 2, axis=0)
+    np.testing.assert_allclose(np.asarray(u2[0]), expected, rtol=1e-4)
+
+
+def test_value_and_grad_wrapper(hvd8):
+    per_rank_x = jnp.asarray(
+        np.random.RandomState(4).randn(N, 5).astype(np.float32))
+
+    def body(x):
+        def loss(w):
+            return jnp.sum(w * x)
+        val, g = hvd.value_and_grad(loss)(jnp.ones((5,), jnp.float32))
+        return g
+
+    out = run_spmd(hvd8, body, per_rank_x)
+    expected = np.mean(np.asarray(per_rank_x), axis=0)
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-5)
+
+
+def test_grad_wrapper_sum(hvd8):
+    per_rank_x = jnp.asarray(
+        np.random.RandomState(5).randn(N, 3).astype(np.float32))
+
+    def body(x):
+        g = hvd.grad(lambda w: jnp.sum(w * x), op=hvd.Sum)(
+            jnp.ones((3,), jnp.float32))
+        return g
+
+    out = run_spmd(hvd8, body, per_rank_x)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.sum(np.asarray(per_rank_x), 0), rtol=1e-5)
+
+
+def test_adasum_delta_step_ranks_agree(hvd8):
+    opt = optax.sgd(0.5)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    g = jnp.asarray(np.random.RandomState(6).randn(N, 4).astype(np.float32))
+
+    def body(gr):
+        state = opt.init(params)
+        new_params, _ = hvd.adasum_delta_step(opt, params, {"w": gr}, state)
+        return new_params["w"]
+
+    out = np.asarray(run_spmd(hvd8, body, g))
+    for r in range(1, N):
+        np.testing.assert_allclose(out[r], out[0], rtol=1e-5)
+    assert not np.allclose(out[0], np.ones(4))  # something happened
+
+
+def test_optimizer_num_groups(hvd8):
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), num_groups=2)
+    params = {"a": jnp.zeros((2,)), "b": jnp.zeros((3,)),
+              "c": jnp.zeros((4,))}
+    rng = np.random.RandomState(7)
+    ga = jnp.asarray(rng.randn(N, 2).astype(np.float32))
+    gb = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    gc = jnp.asarray(rng.randn(N, 4).astype(np.float32))
+
+    def body(a, b, c):
+        state = opt.init(params)
+        updates, _ = opt.update({"a": a, "b": b, "c": c}, state, params)
+        return updates["a"], updates["b"], updates["c"]
+
+    ua, ub, uc = run_spmd(hvd8, body, ga, gb, gc)
+    np.testing.assert_allclose(np.asarray(ua[0]),
+                               -np.mean(np.asarray(ga), 0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(uc[0]),
+                               -np.mean(np.asarray(gc), 0), rtol=1e-5)
+
+
+def test_broadcast_variables_tree(hvd8):
+    params = {"w": jnp.full((3, 2), 5.0), "b": jnp.arange(4.0)}
+    out = hvd.broadcast_variables(params, root_rank=0)
+    assert out["w"].shape == (3, 2)
+    np.testing.assert_allclose(out["b"], np.arange(4.0))
+
+
+def test_broadcast_optimizer_state(hvd8):
+    opt = optax.adam(1e-3)
+    state = opt.init({"w": jnp.ones((3,))})
+    out = hvd.broadcast_optimizer_state(state, root_rank=0)
+    leaves = jax.tree_util.tree_leaves(out)
+    assert len(leaves) == len(jax.tree_util.tree_leaves(state))
+
+
+def test_broadcast_object_and_allgather_object(hvd8):
+    obj = {"epoch": 3, "lr": 0.1}
+    assert hvd.broadcast_object(obj) == obj  # emulated: shared process
+    objs = hvd.allgather_object([{"r": r} for r in range(N)])
+    assert objs == [{"r": r} for r in range(N)]
+    with pytest.raises(ValueError):
+        hvd.allgather_object({"not": "a list"})
+
+
+def test_sync_batch_stats(hvd8):
+    x = np.random.RandomState(8).randn(N, 16, 4).astype(np.float32)
+
+    def body(xb):
+        mean, var = hvd.sync_batch_stats(xb)
+        return mean, var
+
+    mean, var = run_spmd(hvd8, body, jnp.asarray(x))
+    flat = x.reshape(-1, 4)
+    np.testing.assert_allclose(np.asarray(mean[0]), flat.mean(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(var[0]), flat.var(0),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_shard_step_helper(hvd8):
+    step = hvd.parallel.shard_step(
+        lambda w, xb: hvd.allreduce(jnp.sum(xb) * w, op=hvd.Sum),
+        in_specs=(P(), P("hvd")), out_specs=P())
+    x = jnp.ones((8, 2), jnp.float32)
+    out = step(jnp.asarray(2.0), x)
+    np.testing.assert_allclose(np.asarray(out), 2.0 * 16.0)
+
+
+def test_make_mesh_and_hierarchical(hvd8):
+    m = hvd.parallel.make_mesh({"cross": 2, "local": 4})
+    assert m.shape == {"cross": 2, "local": 4}
+    with pytest.raises(ValueError):
+        hvd.parallel.make_mesh({"a": 3})
+    hm = hvd.parallel.hierarchical_mesh()
+    assert int(np.prod(list(hm.shape.values()))) == N
+
+
+def test_invariant_grads_not_double_counted(hvd8):
+    """shard_map's transpose pre-sums grads of replicated params (vma
+    semantics); the optimizer layer must not psum them again."""
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    x = jnp.asarray(np.random.RandomState(9).randn(N, 5).astype(np.float32))
+
+    def body(xr):
+        params = {"w": jnp.ones((5,), jnp.float32)}  # replicated/invariant
+        # grads wrt invariant params arrive already globally summed:
+        # grad = sum_r x_r.  Average must yield mean_r x_r, not psum it again
+        # (which would give N * sum_r x_r).
+        grads = jax.grad(lambda p: jnp.sum(p["w"] * xr))(params)
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        return updates["w"]
+
+    out = run_spmd(hvd8, body, x)
+    expected = -np.mean(np.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-5)
+
+
+def test_tape_local_grads_average_exactly(hvd8):
+    x = jnp.asarray(np.random.RandomState(10).randn(N, 4).astype(np.float32))
+
+    def body(xr):
+        w = jnp.ones((4,), jnp.float32)
+        val, g = hvd.value_and_grad(lambda w: jnp.sum(w * xr))(w)
+        return g
+
+    out = run_spmd(hvd8, body, x)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.mean(np.asarray(x), 0), rtol=1e-5)

@@ -101,3 +101,40 @@ def test_is_homogeneous_heterogeneous_layout():
                   cross_size=3, num_slots=6, local_slots=2,
                   slots_per_node=[2, 2, 2])
     assert t2.is_homogeneous
+
+
+def test_broadcast_variables_param_with_leading_dim_n(hvd8):
+    """A replicated weight whose first dim equals the emulated rank count
+    must NOT be misread as a per-rank stack (review finding)."""
+    w = jnp.asarray(np.random.RandomState(11).randn(N, 16).astype(np.float32))
+    out = hvd.broadcast_variables({"w": w}, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(w))
+
+
+def test_broadcast_stacked_flag_explicit(hvd8):
+    x = jnp.asarray(np.random.RandomState(12).randn(N, 3).astype(np.float32))
+    # explicit stacked=True keeps per-rank semantics
+    out = hvd.broadcast(x, root_rank=2, stacked=True)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), np.asarray(x)[2])
+    # explicit stacked=False treats it as replicated
+    out = hvd.broadcast(x, root_rank=2, stacked=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_duplicate_hostnames_merge():
+    from horovod_tpu.runner import hosts as H
+    slots = H.get_host_assignments(H.parse_hosts("h1:2,h1:2"), 4)
+    pairs = [(s.hostname, s.local_rank) for s in slots]
+    assert len(set(pairs)) == 4  # no duplicate (host, local_rank)
+    assert all(s.cross_size == 1 for s in slots)
+
+
+def test_spawn_failure_counts_as_rank_failure(tmp_path):
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "1",
+         "definitely_not_a_real_binary_xyz"],
+        cwd=repo, capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0

@@ -1,0 +1,192 @@
+"""Launcher tests (reference: test/single/test_run.py — flag parsing, env
+mapping, host assignment — and test/integration/test_static_run.py which
+invokes the real CLI on localhost)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu import config as hvd_config
+from horovod_tpu.runner import hosts as H
+from horovod_tpu.runner.launch import parse_args, env_from_args
+from horovod_tpu.runner.http_server import (
+    KVStoreClient, KVStoreServer, RendezvousServer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- flag parsing / env mapping (test_run.py flag matrix) --------------------
+
+def test_parse_args_basic():
+    args = parse_args(["-np", "4", "-H", "h1:2,h2:2", "--verbose",
+                       "python", "train.py"])
+    assert args.np == 4
+    assert args.hosts == "h1:2,h2:2"
+    assert args.verbose
+    assert args.command == ["python", "train.py"]
+
+
+def test_env_from_args_knobs():
+    args = parse_args([
+        "-np", "2",
+        "--fusion-threshold-mb", "64",
+        "--cycle-time-ms", "0.5",
+        "--cache-capacity", "2048",
+        "--hierarchical-allreduce",
+        "--autotune", "--autotune-log-file", "/tmp/at.log",
+        "--timeline-filename", "/tmp/tl.json", "--timeline-mark-cycles",
+        "--no-stall-check",
+        "--stall-check-warning-time-seconds", "30",
+        "--log-level", "DEBUG",
+        "python", "x.py"])
+    env = env_from_args(args)
+    assert env[hvd_config.HOROVOD_FUSION_THRESHOLD] == str(64 * 1024 * 1024)
+    assert env[hvd_config.HOROVOD_CYCLE_TIME] == "0.5"
+    assert env[hvd_config.HOROVOD_CACHE_CAPACITY] == "2048"
+    assert env[hvd_config.HOROVOD_HIERARCHICAL_ALLREDUCE] == "1"
+    assert env[hvd_config.HOROVOD_AUTOTUNE] == "1"
+    assert env[hvd_config.HOROVOD_AUTOTUNE_LOG] == "/tmp/at.log"
+    assert env[hvd_config.HOROVOD_TIMELINE] == "/tmp/tl.json"
+    assert env[hvd_config.HOROVOD_TIMELINE_MARK_CYCLES] == "1"
+    assert env[hvd_config.HOROVOD_STALL_CHECK_DISABLE] == "1"
+    assert env[hvd_config.HOROVOD_STALL_CHECK_TIME_SECONDS] == "30"
+    assert env[hvd_config.HOROVOD_LOG_LEVEL] == "debug"
+
+
+def test_disable_cache_flag():
+    args = parse_args(["-np", "1", "--disable-cache", "python", "x.py"])
+    assert env_from_args(args)[hvd_config.HOROVOD_CACHE_CAPACITY] == "0"
+
+
+def test_config_file_with_cli_precedence(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        params:
+          fusion-threshold-mb: 32
+          cache-capacity: 512
+        logging:
+          log-level: INFO
+    """))
+    # CLI flag --cache-capacity must beat the config file value.
+    args = parse_args(["-np", "1", "--config-file", str(cfg),
+                       "--cache-capacity", "4096", "python", "x.py"])
+    env = env_from_args(args)
+    assert env[hvd_config.HOROVOD_FUSION_THRESHOLD] == str(32 * 1024 * 1024)
+    assert env[hvd_config.HOROVOD_CACHE_CAPACITY] == "4096"
+    assert env[hvd_config.HOROVOD_LOG_LEVEL] == "info"
+
+
+def test_gloo_mpi_flags_mutually_exclusive():
+    with pytest.raises(SystemExit):
+        parse_args(["-np", "1", "--gloo", "--mpi", "python", "x.py"])
+
+
+# -- host assignment (hosts.py:100) -----------------------------------------
+
+def test_parse_hosts():
+    hs = H.parse_hosts("h1:2,h2:4,h3")
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("h1 slots=2\n# comment\nh2 slots=4\n")
+    hs = H.parse_host_files(str(f))
+    assert [(h.hostname, h.slots) for h in hs] == [("h1", 2), ("h2", 4)]
+
+
+def test_host_assignments_ranks():
+    hs = H.parse_hosts("h1:2,h2:2")
+    slots = H.get_host_assignments(hs, 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "h1", 0, 0), (1, "h1", 1, 0), (2, "h2", 0, 1), (3, "h2", 1, 1)]
+    assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+               for s in slots)
+
+
+def test_host_assignments_oversubscribe_rejected():
+    with pytest.raises(ValueError, match="slots available"):
+        H.get_host_assignments(H.parse_hosts("h1:1"), 4)
+
+
+def test_host_assignments_partial_use():
+    slots = H.get_host_assignments(H.parse_hosts("h1:4,h2:4"), 3)
+    assert len(slots) == 3
+    assert slots[-1].hostname == "h1"
+
+
+# -- KV store / rendezvous (http_server.py) ---------------------------------
+
+def test_kvstore_put_get_roundtrip():
+    srv = KVStoreServer()
+    port = srv.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        client.put("scope1", "key1", b"value1")
+        assert client.get("scope1", "key1") == b"value1"
+        assert client.get("scope1", "missing") is None
+        assert client.get("other", "key1") is None
+    finally:
+        srv.stop()
+
+
+def test_rendezvous_publishes_slots():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        slots = H.get_host_assignments(H.parse_hosts("localhost:2"), 2)
+        srv.init(slots)
+        client = KVStoreClient("127.0.0.1", port)
+        rec = json.loads(client.get("rendezvous", "rank/1"))
+        assert rec["rank"] == 1 and rec["local_rank"] == 1
+        assert client.get("rendezvous", "size") == b"2"
+    finally:
+        srv.stop()
+
+
+# -- integration: real CLI on localhost (test_static_run.py analog) ----------
+
+WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd
+hvd.init()
+import jax.numpy as jnp
+out = hvd.allreduce(jnp.array([float(hvd.rank()+1)]), op=hvd.Sum)
+assert float(out[0]) == 3.0, out
+print(f"RANK{{hvd.rank()}} OK")
+"""
+
+
+@pytest.mark.integration
+def test_static_run_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("HOROVOD_RANK", "HOROVOD_SIZE")}
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RANK0 OK" in proc.stdout
+    assert "RANK1 OK" in proc.stdout
+
+
+@pytest.mark.integration
+def test_static_run_failure_propagates(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "ranks failed" in proc.stderr
