@@ -60,6 +60,7 @@ from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
 from . import parallel  # noqa: F401
 
 from . import runner  # noqa: F401
+from . import elastic  # noqa: F401
 run = runner.run  # launcher API (reference: horovod.run, runner/__init__.py:95)
 
 from .process_sets import (  # noqa: F401

@@ -1,0 +1,218 @@
+"""Elastic training: fault tolerance + autoscaling.
+
+Reference surface (horovod.elastic / hvd.elastic):
+
+* ``hvd.elastic.run(train_fn)`` — retry wrapper (common/elastic.py:151
+  run_fn): catches ``HorovodInternalError`` (failed collective → restore
+  last commit + full reinit) and ``HostsUpdatedInterrupt`` (membership
+  change → commit survives, reinit, optionally skip sync on pure scale-up).
+* ``State`` / ``ObjectState`` / ``TpuState`` (state.py) — commit/restore/
+  sync objects (TorchState analog).
+* Driver side: ElasticDriver + discovery + WorkerStateRegistry (driver.py,
+  discovery.py, registration.py), wired into ``horovodrun`` via
+  ``--min-np/--max-np/--host-discovery-script/--reset-limit/
+  --blacklist-cooldown-range``.
+
+Reset on TPU: world-size changes force recompilation of every jitted
+collective (SURVEY.md §7 "Elastic world-size changes") — the reset path
+re-reads the slot record from the rendezvous KV store, re-initializes
+``jax.distributed`` over the survivors, rebuilds the mesh, and the user's
+reset callbacks re-jit; XLA's compilation cache hides most of the latency
+for shapes seen before.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils import get_logger
+from .. import config as _config
+from .state import State, ObjectState, ArrayState, TpuState  # noqa: F401
+from .driver import ElasticDriver  # noqa: F401
+from .discovery import (  # noqa: F401
+    HostDiscovery, HostDiscoveryScript, FixedHostDiscovery, HostManager)
+
+
+class WorkerNotificationManager:
+    """Worker-side host-update listener.
+
+    Reference: horovod/runner/elastic/worker.py:46 WorkerNotificationService
+    (socket RPC per worker).  Here: a daemon thread polls the rendezvous KV
+    key ``discovery/update``; on version bump every registered State gets
+    ``on_hosts_updated`` so its next ``commit()`` raises
+    HostsUpdatedInterrupt."""
+
+    def __init__(self):
+        self._listeners: List[State] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._seen_version = 0
+        self._lock = threading.Lock()
+
+    def init(self):
+        if self._thread is not None:
+            return
+        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+        if not addr or not port or \
+                os.environ.get("HOROVOD_ELASTIC") != "1":
+            return  # not an elastic run: no-op manager
+        from ..runner.http_server import KVStoreClient
+        client = KVStoreClient(addr, int(port))
+
+        def poll():
+            while not self._stop.is_set():
+                try:
+                    raw = client.get("discovery", "update")
+                    if raw:
+                        rec = json.loads(raw)
+                        if rec["version"] > self._seen_version:
+                            self._seen_version = rec["version"]
+                            with self._lock:
+                                for st in self._listeners:
+                                    st.on_hosts_updated(rec.get("hosts"),
+                                                        rec.get("res", 1))
+                except Exception as e:
+                    get_logger().debug("notification poll failed: %s", e)
+                self._stop.wait(1.0)
+
+        self._thread = threading.Thread(target=poll, daemon=True,
+                                        name="hvd-worker-notify")
+        self._thread.start()
+
+    def register_listener(self, state: State):
+        with self._lock:
+            if state._host_messages is None:
+                state._host_messages = []
+            self._listeners.append(state)
+
+    def remove_listener(self, state: State):
+        with self._lock:
+            if state in self._listeners:
+                self._listeners.remove(state)
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def _refresh_world_from_rendezvous() -> None:
+    """After a reset, fetch this worker's new slot record keyed by
+    (hostname, local_rank) from the rendezvous KV store and refresh the
+    HOROVOD_* env (the gloo elastic re-rendezvous pattern,
+    runner/http/http_server.py elastic handler).
+
+    Version gate: the KV store still holds the previous world's records
+    while the driver reshapes; we wait for a world version strictly newer
+    than the one we left (HVD_TPU_WORLD_VERSION) and a slot record stamped
+    with that version."""
+    addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+    port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+    if not addr or not port:
+        return
+    from ..runner.http_server import KVStoreClient
+    client = KVStoreClient(addr, int(port))
+    hostname = os.environ.get(_config.HOROVOD_HOSTNAME, socket.gethostname())
+    local_rank = os.environ.get(_config.HOROVOD_LOCAL_RANK, "0")
+    last_version = int(os.environ.get("HVD_TPU_WORLD_VERSION", "0"))
+    deadline = time.time() + float(
+        os.environ.get(_config.HOROVOD_ELASTIC_TIMEOUT, "600"))
+    while time.time() < deadline:
+        try:
+            world_raw = client.get("rendezvous", "world")
+            world = json.loads(world_raw) if world_raw else {"version": 0}
+            if world.get("version", 0) > last_version:
+                raw = client.get("rendezvous",
+                                 f"slot/{hostname}/{local_rank}")
+                if raw:
+                    rec = json.loads(raw)
+                    if rec.get("version", 0) == world["version"]:
+                        os.environ[_config.HOROVOD_RANK] = str(rec["rank"])
+                        os.environ[_config.HOROVOD_SIZE] = str(rec["size"])
+                        os.environ[_config.HOROVOD_LOCAL_RANK] = \
+                            str(rec["local_rank"])
+                        os.environ[_config.HOROVOD_LOCAL_SIZE] = \
+                            str(rec["local_size"])
+                        os.environ[_config.HOROVOD_CROSS_RANK] = \
+                            str(rec["cross_rank"])
+                        os.environ[_config.HOROVOD_CROSS_SIZE] = \
+                            str(rec["cross_size"])
+                        os.environ["HVD_TPU_WORLD_VERSION"] = \
+                            str(rec["version"])
+                        return
+        except Exception as e:
+            get_logger().debug("rendezvous refresh retry: %s", e)
+        time.sleep(0.5)
+    raise HorovodInternalError(
+        "timed out waiting for a slot assignment after reset")
+
+
+def _reset() -> None:
+    """Full reinit: shutdown the runtime, re-rendezvous, re-init
+    (common/elastic.py run_fn 'reinit' = shutdown + re-rendezvous)."""
+    from .. import core as _core
+    _core.shutdown()
+    if os.environ.get("HOROVOD_ELASTIC") == "1":
+        _refresh_world_from_rendezvous()
+        try:
+            import jax
+            from jax._src import distributed as _jdist
+            if getattr(_jdist.global_state, "client", None) is not None:
+                jax.distributed.shutdown()
+        except Exception as e:
+            get_logger().warning("jax.distributed shutdown failed: %s", e)
+    _core.init()
+
+
+def run(func):
+    """Elastic retry decorator (hvd.elastic.run, common/elastic.py:151).
+
+    Usage::
+
+        state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
+                                     epoch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            for epoch in range(state.epoch, 90):
+                ...train...
+                state.epoch = epoch
+                state.commit()
+
+        train(state)
+    """
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        skip_sync = False
+        reset_required = False
+        try:
+            while True:
+                if reset_required:
+                    _reset()
+                    state.on_reset()
+                try:
+                    if not skip_sync:
+                        state.sync()
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    get_logger().info(
+                        "elastic: collective failure — restoring last commit")
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    get_logger().info(
+                        "elastic: host membership changed — reinitializing")
+                    skip_sync = e.skip_sync
+                reset_required = True
+        finally:
+            notification_manager.remove_listener(state)
+
+    return wrapper

@@ -1,0 +1,335 @@
+"""Elastic driver: discovery loop, slot assignment, worker lifecycle.
+
+Reference: horovod/runner/elastic/driver.py:69 ElasticDriver — background
+discovery thread (1 s period) runs the user script; on host changes it
+notifies workers; ``start()`` waits for min slots, assigns ranks
+*preserving existing slots* (driver.py:240-272), spawns a worker per new
+slot; worker exits are recorded by WorkerStateRegistry which triggers
+``resume()`` (host blacklist + rank reassignment + respawn).  The reset
+limit counts world reshapes, not individual worker exits, so one multi-slot
+host failure is one reset.
+
+TPU build notification channel: instead of per-worker socket RPC services
+(elastic/worker.py:46), the driver publishes a monotonically increasing
+``discovery/update`` sequence (+ the host set) in the rendezvous KV store;
+each worker polls it from a daemon thread (WorkerNotificationManager in
+__init__.py) and surfaces HostsUpdatedInterrupt at the next
+``state.commit()`` — same contract, one fewer service.  World records carry
+a ``version``; workers re-rendezvousing after a reset wait for a version
+newer than the world they left (elastic/__init__.py
+_refresh_world_from_rendezvous), which closes the stale-record race.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import get_logger
+from ..runner import hosts as _hosts
+from ..runner import safe_shell_exec
+from ..runner.http_server import RendezvousServer
+from .. import config as _config
+from .discovery import HostDiscovery, HostDiscoveryScript, HostManager
+from .registration import WorkerStateRegistry
+
+DISCOVER_INTERVAL_S = 1.0
+
+
+class Worker:
+    def __init__(self, host: str, slot: int, version: int = 0):
+        self.host = host
+        self.slot = slot
+        self.version = version  # refreshed on every world reactivation
+        self.thread: Optional[threading.Thread] = None
+        self.terminate_event = threading.Event()
+
+
+class ElasticDriver:
+    """driver.py:69 ElasticDriver analog."""
+
+    def __init__(self, rendezvous: RendezvousServer,
+                 discovery: HostDiscovery,
+                 min_np: int, max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None,
+                 cooldown_range: Optional[Tuple[float, float]] = None,
+                 timeout: float = 600.0,
+                 verbose: bool = False):
+        self.rendezvous = rendezvous
+        self.host_manager = HostManager(discovery, cooldown_range)
+        self.min_np = min_np
+        self.max_np = max_np or min_np
+        self.timeout = timeout
+        self.registry = WorkerStateRegistry(self, self.host_manager,
+                                            reset_limit=reset_limit)
+        self._workers: Dict[Tuple[str, int], Worker] = {}
+        self._assignments: List[_hosts.SlotInfo] = []
+        self._world_version = 0
+        self._update_seq = 0  # discovery-update sequence, own counter
+        self._shutdown = threading.Event()
+        self._error_message: Optional[str] = None
+        self._resumes_inflight = 0
+        self._resume_pending = False
+        self._lock = threading.RLock()
+        self._worker_cmd_fn: Optional[Callable] = None
+        self._discovery_thread = threading.Thread(
+            target=self._discover_loop, daemon=True, name="hvd-elastic-disc")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, create_worker_fn: Callable) -> None:
+        """Wait for min slots and launch the initial world (driver.py:102).
+        World size is min(max_np, available slots)."""
+        self._worker_cmd_fn = create_worker_fn
+        self.wait_for_available_slots(self.min_np)
+        self._activate_world()
+        self._discovery_thread.start()
+
+    def wait_for_available_slots(self, min_np: int) -> None:
+        deadline = time.time() + self.timeout
+        while not self._shutdown.is_set():
+            self.host_manager.update_available_hosts()
+            if self.host_manager.available_slots >= min_np:
+                return
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"Timed out waiting for {min_np} slots "
+                    f"(--start-timeout / HOROVOD_ELASTIC_TIMEOUT); "
+                    f"currently available: "
+                    f"{self.host_manager.available_slots}")
+            time.sleep(DISCOVER_INTERVAL_S)
+
+    def stop(self, error_message: Optional[str] = None) -> None:
+        self._error_message = error_message
+        self._shutdown.set()
+        with self._lock:
+            for w in self._workers.values():
+                w.terminate_event.set()
+
+    def join(self) -> None:
+        """Wait until the job settles: no live workers and no resume pending
+        or in flight (or the driver was stopped).  Worker threads register
+        failures *before* deregistering themselves (registration ordering in
+        _launch_worker), so there is no idle gap where a pending resume is
+        invisible."""
+        while not self._shutdown.is_set():
+            with self._lock:
+                idle = (not self._workers and self._resumes_inflight == 0
+                        and not self._resume_pending)
+            if idle:
+                return
+            time.sleep(0.05)
+
+    @property
+    def error_message(self) -> Optional[str]:
+        return self._error_message
+
+    @property
+    def world_version(self) -> int:
+        return self._world_version
+
+    def current_assignments(self) -> List[_hosts.SlotInfo]:
+        with self._lock:
+            return list(self._assignments)
+
+    # -- discovery loop ------------------------------------------------------
+
+    def _discover_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                res = self.host_manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup: keep going
+                get_logger().warning("discovery failed: %s", e)
+                res = 0
+            if res:
+                self._notify_workers_host_changes(res)
+                if res == 1:
+                    # Hosts removed: terminate their workers and reshape the
+                    # world so survivors re-rendezvous into fresh records.
+                    self._terminate_workers_on_lost_hosts()
+                    self.request_resume(additive=False, count_reset=True)
+                elif res == 2 and self.host_manager.available_slots > \
+                        len(self._assignments) and \
+                        len(self._assignments) < self.max_np:
+                    # Pure scale-up: workers will interrupt & re-rendezvous
+                    # at next commit; prepare the new world eagerly.
+                    self.request_resume(additive=True, count_reset=False)
+            self._shutdown.wait(DISCOVER_INTERVAL_S)
+
+    def _terminate_workers_on_lost_hosts(self):
+        with self._lock:
+            current = set(self.host_manager.current_hosts.keys())
+            for (host, slot), w in self._workers.items():
+                if host not in current:
+                    w.terminate_event.set()
+
+    def _notify_workers_host_changes(self, update_res: int):
+        """KV-store sequence bump — worker poll threads pick it up
+        (WorkerNotificationClient analog, driver.py:210-238)."""
+        with self._lock:
+            self._update_seq += 1
+            seq = self._update_seq
+        self.rendezvous.put(
+            "discovery", "update",
+            json.dumps({"version": seq,
+                        "res": update_res,
+                        "hosts": self.host_manager.current_hosts}).encode())
+
+    # -- world (re)activation ------------------------------------------------
+
+    def _activate_world(self):
+        """Compute assignments preserving existing slots (driver.py:240-272)
+        and publish them; spawn workers for slots that lack one."""
+        with self._lock:
+            np_ = min(self.max_np, self.host_manager.available_slots)
+            new_assignments = self._assign_preserving(np_)
+            self._assignments = new_assignments
+            self._world_version += 1
+            self.registry.reset(len(new_assignments))
+            for slot in new_assignments:
+                payload = json.dumps(
+                    {**slot.to_dict(), "version": self._world_version})
+                self.rendezvous.put(
+                    "rendezvous", f"slot/{slot.hostname}/{slot.local_rank}",
+                    payload.encode())
+                self.rendezvous.put("rendezvous", f"rank/{slot.rank}",
+                                    payload.encode())
+            self.rendezvous.put("rendezvous", "size",
+                                str(len(new_assignments)).encode())
+            self.rendezvous.put(
+                "rendezvous", "world",
+                json.dumps({"version": self._world_version,
+                            "size": len(new_assignments)}).encode())
+            for slot in new_assignments:
+                key = (slot.hostname, slot.local_rank)
+                if key in self._workers:
+                    # Surviving worker adopted into the new world: a later
+                    # failure is a fresh event, not a stale one.
+                    self._workers[key].version = self._world_version
+                else:
+                    self._launch_worker(slot)
+
+    def _assign_preserving(self, np_: int) -> List[_hosts.SlotInfo]:
+        """Rank assignment preferring hosts that already run workers so
+        surviving processes keep their (host, local_rank) slot
+        (driver.py:240-272)."""
+        hosts_now = self.host_manager.current_hosts
+        existing_hosts = [h for h, _ in self._workers.keys()]
+        ordered = sorted(
+            hosts_now.keys(),
+            key=lambda h: (0 if h in existing_hosts else 1, h))
+        host_list = [_hosts.HostInfo(h, hosts_now[h]) for h in ordered]
+        return _hosts.get_host_assignments(host_list, min(
+            np_, sum(hosts_now.values())))
+
+    def _launch_worker(self, slot: _hosts.SlotInfo):
+        worker = Worker(slot.hostname, slot.local_rank, self._world_version)
+        self._workers[(slot.hostname, slot.local_rank)] = worker
+        spawn_version = self._world_version
+
+        def run():
+            ret = self._worker_cmd_fn(slot, worker.terminate_event,
+                                      spawn_version)
+            if self._shutdown.is_set():
+                with self._lock:
+                    self._workers.pop((slot.hostname, slot.local_rank), None)
+                return
+            # Record BEFORE deregistering so join() never sees an idle gap
+            # between worker exit and the resume request.
+            if ret == 0:
+                self.registry.record_success(slot.hostname, slot.local_rank,
+                                             worker.version)
+            else:
+                self.registry.record_failure(slot.hostname, slot.local_rank,
+                                             worker.version)
+            with self._lock:
+                self._workers.pop((slot.hostname, slot.local_rank), None)
+
+        worker.thread = threading.Thread(target=run, daemon=True,
+                                         name=f"hvd-worker-{slot.rank}")
+        worker.thread.start()
+
+    # -- resume --------------------------------------------------------------
+
+    def request_resume(self, additive: bool = False,
+                       count_reset: bool = True) -> bool:
+        """Schedule one world reshape; concurrent requests coalesce.
+        Returns True when a new resume was scheduled (used by the registry
+        to count resets per reshape, not per failed worker)."""
+        if self._shutdown.is_set():
+            return False
+        with self._lock:
+            if self._resume_pending:
+                return False
+            self._resume_pending = True
+            self._resumes_inflight += 1
+        threading.Thread(target=self._resume, args=(additive,), daemon=True,
+                         name="hvd-elastic-resume").start()
+        return True
+
+    def _resume(self, additive: bool) -> None:
+        """Reshape the world after failure or scale-up (driver.py:304)."""
+        try:
+            try:
+                self.wait_for_available_slots(self.min_np)
+            except RuntimeError as e:
+                self.stop(error_message=str(e))
+                return
+            if self._shutdown.is_set():
+                return
+            self._activate_world()
+        finally:
+            with self._lock:
+                self._resume_pending = False
+                self._resumes_inflight -= 1
+
+    # Back-compat spelling used in docs/tests.
+    def resume(self, additive: bool = False) -> None:
+        self.request_resume(additive=additive)
+
+
+def _routable_self_addr() -> str:
+    """Address remote workers can dial back to (driver_service.py NIC
+    probing, simplified: hostname lookup with loopback fallback)."""
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        return addr
+    except OSError:
+        return "127.0.0.1"
+
+
+def launch_elastic(args) -> int:
+    """CLI entry for elastic runs (launch.py:689 _run_elastic analog)."""
+    if not args.host_discovery_script:
+        print("horovodrun: elastic mode requires --host-discovery-script",
+              file=sys.stderr)
+        return 2
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np or min_np
+    discovery = HostDiscoveryScript(args.host_discovery_script,
+                                    slots=args.slots)
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    port = rendezvous.start()
+    addr = _routable_self_addr()
+
+    from .launch_support import make_elastic_worker_fn
+    driver = ElasticDriver(
+        rendezvous, discovery, min_np, max_np,
+        reset_limit=args.reset_limit,
+        cooldown_range=tuple(args.blacklist_cooldown_range)
+        if args.blacklist_cooldown_range else None,
+        timeout=args.start_timeout or 600)
+    worker_fn = make_elastic_worker_fn(args, addr, port, driver)
+    driver.start(worker_fn)
+    driver.join()
+    if driver.error_message:
+        print(f"horovodrun: {driver.error_message}", file=sys.stderr)
+        return 1
+    states = driver.registry.last_rank_states()
+    failed = [k for k, v in states.items() if v == "FAILURE"]
+    return 1 if failed else 0

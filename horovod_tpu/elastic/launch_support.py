@@ -1,0 +1,54 @@
+"""Worker process launch for elastic runs (gloo_run.py:370 elastic variant).
+
+Each worker process gets HOROVOD_ELASTIC=1 plus the rendezvous address; its
+rank/size env reflects the slot at spawn time, but on re-rendezvous the
+worker refreshes them from the KV store (elastic/__init__.py
+_refresh_world_from_rendezvous) because ranks can change across resets.
+HVD_TPU_WORLD_VERSION pins the world generation the worker was spawned
+into, so post-reset refreshes can reject stale slot records.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+from .. import config as _config
+from ..runner import hosts as _hosts
+from ..runner import safe_shell_exec
+from ..runner.launch import env_from_args, _is_local, _ssh_command
+
+
+def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
+    base_env = dict(os.environ)
+    base_env.update(env_from_args(args))
+
+    def worker_fn(slot: _hosts.SlotInfo, terminate_event: threading.Event,
+                  world_version: int):
+        env = dict(base_env)
+        env.update({
+            _config.HOROVOD_RANK: str(slot.rank),
+            _config.HOROVOD_SIZE: str(slot.size),
+            _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
+            _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
+            _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
+            _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
+            _config.HOROVOD_HOSTNAME: slot.hostname,
+            _config.HOROVOD_RENDEZVOUS_ADDR: addr,
+            _config.HOROVOD_RENDEZVOUS_PORT: str(port),
+            "HOROVOD_ELASTIC": "1",
+            "HVD_TPU_WORLD_VERSION": str(world_version),
+            "HVD_TPU_COORDINATOR":
+                f"{addr}:{int(os.environ.get('HVD_TPU_COORD_PORT', 29400))}",
+        })
+        prefix = f"[{slot.rank}]<stdout>:"
+        cmd = args.command if _is_local(slot.hostname) else \
+            _ssh_command(slot, args.command, env, args)
+        return safe_shell_exec.execute(
+            cmd, env=env, prefix=prefix,
+            prefix_timestamp=getattr(args, "prefix_output_with_timestamp",
+                                     False),
+            events=[terminate_event])
+
+    return worker_fn

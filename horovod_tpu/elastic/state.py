@@ -1,0 +1,183 @@
+"""Elastic state objects: in-memory checkpoint + cross-rank sync.
+
+Reference: horovod/common/elastic.py:26 (State: save/restore/sync +
+reset-callback registry + ``check_host_updates`` raising
+HostsUpdatedInterrupt), :116 (ObjectState), and the torch handlers
+(torch/elastic/state.py:27-130: ModelStateHandler/OptimizerStateHandler
+do in-memory save/restore and broadcast-based sync).
+
+TPU build: ``ArrayState`` handles jax pytrees (params/optimizer state) —
+commit copies to host memory (device_get), restore device_puts the last
+commit, sync broadcasts from the new coordinator (rank 0) after a reset.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..exceptions import HostsUpdatedInterrupt
+from .. import functions as _functions
+
+
+class State:
+    """State representation for `hvd.elastic.run` (common/elastic.py:26).
+
+    Subclasses implement save/restore/sync; users call ``commit()`` at safe
+    points (typically every N batches) and the elastic loop calls
+    ``restore()`` after a failure or ``sync()`` after a topology change."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable] = []
+        self._host_messages = None  # set by the notification manager
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """Callbacks invoked after world reset (re-jit, rebuild data sharding
+        — common/elastic.py register_reset_callbacks)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, updated_hosts, update_res) -> None:
+        if self._host_messages is not None:
+            self._host_messages.append((updated_hosts, update_res))
+
+    def commit(self) -> None:
+        """Checkpoint to memory and check for host changes
+        (common/elastic.py State.commit)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt when membership changed
+        (common/elastic.py:83 check_host_updates)."""
+        if self._host_messages is not None and self._host_messages:
+            # skip_sync if only scale-up: HostManager encodes additive
+            # updates as res == 2 and removals as res == 1.
+            all_additive = all(res == 2 for _, res in self._host_messages)
+            self._host_messages.clear()
+            raise HostsUpdatedInterrupt(skip_sync=all_additive)
+
+    # Subclass interface -----------------------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """State for arbitrary pickleable attributes (common/elastic.py:116
+    ObjectState): attributes set via kwargs, saved/restored by deep copy,
+    synced by rank-0 object broadcast."""
+
+    def __init__(self, bcast_object=None, get_rank=None, **kwargs):
+        self._bcast_object = bcast_object or _functions.broadcast_object
+        self._saved_state = dict(kwargs)
+        self.__dict__.update(kwargs)
+        super().__init__()
+
+    def save(self) -> None:
+        new_state = {}
+        for attr in self._saved_state.keys():
+            new_state[attr] = copy.deepcopy(getattr(self, attr))
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        self.__dict__.update(copy.deepcopy(self._saved_state))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            self._saved_state = synced
+            self.__dict__.update(
+                {k: copy.deepcopy(v) for k, v in synced.items()})
+
+
+class ArrayState(State):
+    """State for jax pytrees (params, optimizer state) — the TPU analog of
+    TorchState's ModelStateHandler/OptimizerStateHandler
+    (torch/elastic/state.py:27-130)."""
+
+    def __init__(self, **trees):
+        self._trees: Dict[str, Any] = dict(trees)
+        self._saved: Dict[str, Any] = {
+            k: jax.device_get(v) for k, v in trees.items()}
+        for k, v in trees.items():
+            setattr(self, k, v)
+        super().__init__()
+
+    def save(self) -> None:
+        """Commit to host memory (in-memory checkpoint, SURVEY.md §5.4)."""
+        self._saved = {k: jax.device_get(getattr(self, k))
+                       for k in self._trees.keys()}
+
+    def restore(self) -> None:
+        for k in self._trees.keys():
+            setattr(self, k, jax.tree_util.tree_map(
+                jax.numpy.asarray, self._saved[k]))
+
+    def sync(self) -> None:
+        """Broadcast current values from rank 0 (state.sync after
+        re-rendezvous, common/elastic.py run_fn)."""
+        for k in self._trees.keys():
+            setattr(self, k, _functions.broadcast_variables(
+                getattr(self, k), root_rank=0))
+
+
+class TpuState(ObjectState):
+    """Combined convenience state: jax pytrees + plain Python attributes.
+
+    hvd.elastic.TpuState(params=..., opt_state=..., epoch=0, batch=0) —
+    the analog of hvd.elastic.TorchState(model, optimizer, epoch=..).
+    """
+
+    def __init__(self, bcast_object=None, **kwargs):
+        self._array_keys = [k for k, v in kwargs.items()
+                            if _is_pytree_of_arrays(v)]
+        self._object_keys = [k for k in kwargs if k not in self._array_keys]
+        self._arrays_saved = {}
+        super().__init__(bcast_object=bcast_object, **kwargs)
+        self.save()
+
+    def save(self) -> None:
+        for k in self._array_keys:
+            self._arrays_saved[k] = jax.device_get(getattr(self, k))
+        new_state = {k: copy.deepcopy(getattr(self, k))
+                     for k in self._object_keys}
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for k in self._array_keys:
+            setattr(self, k, jax.tree_util.tree_map(
+                jax.numpy.asarray, self._arrays_saved[k]))
+        self.__dict__.update(copy.deepcopy(self._saved_state))
+
+    def sync(self) -> None:
+        for k in self._array_keys:
+            setattr(self, k, _functions.broadcast_variables(
+                getattr(self, k), root_rank=0))
+        if self._object_keys:
+            synced = self._bcast_object(
+                {k: getattr(self, k) for k in self._object_keys},
+                root_rank=0)
+            self.__dict__.update(copy.deepcopy(synced))
+
+
+def _is_pytree_of_arrays(v) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    if not leaves:
+        return False
+    import numpy as np
+    return all(isinstance(l, (jax.Array, np.ndarray)) for l in leaves)

@@ -1,0 +1,383 @@
+"""Elastic subsystem tests.
+
+Mirrors the reference's split (SURVEY.md §4): driver logic tested
+single-process with scripted discovery and simulated worker exits
+(test/single/test_elastic_driver.py), state save/restore without a cluster
+(test/single/test_torch_elastic.py), and the retry loop with synthetic
+exceptions (common/elastic.py contract).
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic as E
+from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from horovod_tpu.runner.http_server import RendezvousServer
+
+N = 8
+
+
+# -- state objects -----------------------------------------------------------
+
+def test_object_state_save_restore(hvd8):
+    state = E.ObjectState(epoch=1, batch=10)
+    state.epoch = 5
+    state.batch = 99
+    state.restore()
+    assert state.epoch == 1 and state.batch == 10
+    state.epoch = 7
+    state.save()
+    state.epoch = 0
+    state.restore()
+    assert state.epoch == 7
+
+
+def test_tpu_state_arrays_and_objects(hvd8):
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = E.TpuState(params=params, epoch=0)
+    state.params = {"w": state.params["w"] * 3}
+    state.epoch = 2
+    state.restore()  # back to the initial commit
+    np.testing.assert_allclose(np.asarray(state.params["w"]), np.ones(4))
+    assert state.epoch == 0
+    state.params = {"w": state.params["w"] * 5}
+    state.epoch = 3
+    state.commit()
+    state.params = {"w": state.params["w"] * 100}
+    state.restore()
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 5 * np.ones(4))
+    assert state.epoch == 3
+    state.sync()  # emulated: broadcast path exercised, values unchanged
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 5 * np.ones(4))
+
+
+def test_state_reset_callbacks(hvd8):
+    calls = []
+    state = E.ObjectState(x=1)
+    state.register_reset_callbacks([lambda: calls.append("a"),
+                                    lambda: calls.append("b")])
+    state.on_reset()
+    assert calls == ["a", "b"]
+
+
+def test_check_host_updates_raises(hvd8):
+    state = E.ObjectState(x=1)
+    state._host_messages = []
+    state.on_hosts_updated({"h1": 2}, 1)
+    with pytest.raises(HostsUpdatedInterrupt) as ei:
+        state.commit()
+    assert not ei.value.skip_sync  # removal requires sync
+    state.on_hosts_updated({"h1": 2, "h2": 2}, 2)
+    with pytest.raises(HostsUpdatedInterrupt) as ei:
+        state.check_host_updates()
+    assert ei.value.skip_sync  # pure scale-up
+
+
+# -- retry loop (common/elastic.py:151) ---------------------------------------
+
+def test_elastic_run_retries_on_internal_error(hvd8):
+    events = []
+
+    class FakeState(E.State):
+        def __init__(self):
+            super().__init__()
+            self.restored = 0
+
+        def save(self): events.append("save")
+        def restore(self): self.restored += 1; events.append("restore")
+        def sync(self): events.append("sync")
+
+    state = FakeState()
+    attempts = []
+
+    @E.run
+    def train(st):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise HorovodInternalError("collective failed")
+        return "done"
+
+    assert train(state) == "done"
+    assert len(attempts) == 3
+    assert state.restored == 2
+    assert events.count("sync") == 3  # sync after every restore + initial
+
+
+def test_elastic_run_hosts_updated_skips_sync_on_scaleup(hvd8):
+    syncs = []
+
+    class FakeState(E.State):
+        def save(self): pass
+        def restore(self): pass
+        def sync(self): syncs.append(1)
+
+    state = FakeState()
+    attempts = []
+
+    @E.run
+    def train(st):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise HostsUpdatedInterrupt(skip_sync=True)
+        return 42
+
+    assert train(state) == 42
+    assert len(syncs) == 1  # only the initial sync; scale-up skipped one
+
+
+# -- discovery / blacklist ----------------------------------------------------
+
+def test_discovery_script_parsing(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho h1:4\necho h2\n")
+    script.chmod(0o755)
+    d = E.HostDiscoveryScript(str(script), slots=2)
+    assert d.find_available_hosts_and_slots() == {"h1": 4, "h2": 2}
+
+
+def test_blacklist_cooldown():
+    from horovod_tpu.elastic.discovery import Blacklist
+    bl = Blacklist(cooldown_range=(0.05, 0.2))
+    bl.blacklist("h1")
+    assert bl.is_blacklisted("h1")
+    time.sleep(0.3)
+    assert not bl.is_blacklisted("h1")  # cooled down
+    bl2 = Blacklist(None)
+    bl2.blacklist("h2")
+    time.sleep(0.05)
+    assert bl2.is_blacklisted("h2")  # permanent without range
+
+
+def test_host_manager_update_results():
+    disc = E.FixedHostDiscovery({"h1": 2})
+    hm = E.HostManager(disc)
+    assert hm.update_available_hosts() == 2  # initial add
+    assert hm.update_available_hosts() == 0  # no change
+    disc._hosts["h2"] = 2
+    assert hm.update_available_hosts() == 2  # scale-up
+    del disc._hosts["h1"]
+    assert hm.update_available_hosts() == 1  # removal
+
+
+# -- driver (test_elastic_driver.py analog) -----------------------------------
+
+class RecordingWorkers:
+    """Simulated workers: run until told to exit with a given code."""
+
+    def __init__(self):
+        self.launched = []
+        self.exit_codes = {}
+        self.events = {}
+
+    def fn(self, slot, terminate_event, world_version=0):
+        key = (slot.hostname, slot.local_rank)
+        self.launched.append((slot.rank, key))
+        ev = threading.Event()
+        self.events[key] = ev
+        while not ev.is_set() and not terminate_event.is_set():
+            time.sleep(0.01)
+        return self.exit_codes.get(key, 0)
+
+    def finish(self, host, slot, code=0):
+        self.exit_codes[(host, slot)] = code
+        self.events[(host, slot)].set()
+
+
+def _make_driver(hosts, min_np, max_np, **kwargs):
+    rendezvous = RendezvousServer()
+    rendezvous.start()
+    disc = E.FixedHostDiscovery(hosts)
+    driver = E.ElasticDriver(rendezvous, disc, min_np, max_np, **kwargs)
+    return driver, rendezvous, disc
+
+
+def test_driver_initial_world_and_rendezvous():
+    driver, rdv, disc = _make_driver({"hA": 2, "hB": 2}, 4, 4)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        assert len(workers.launched) == 4
+        rec = json.loads(rdv.get("rendezvous", "rank/0"))
+        assert rec["size"] == 4 and rec["version"] == 1
+        assert rdv.get("rendezvous", "size") == b"4"
+        # graceful completion
+        for host in ("hA", "hB"):
+            for s in (0, 1):
+                workers.finish(host, s, 0)
+        driver.join()
+        assert driver.error_message is None
+        states = driver.registry.last_rank_states()
+        assert all(v == "SUCCESS" for v in states.values())
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+def test_driver_failure_blacklists_and_reassigns():
+    driver, rdv, disc = _make_driver({"hA": 2, "hB": 2}, 2, 4,
+                                     cooldown_range=None)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        v1 = driver.world_version
+        # hB's worker 0 fails -> host blacklisted -> resume with hA only
+        workers.finish("hB", 0, 1)
+        deadline = time.time() + 5
+        while driver.world_version == v1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.world_version > v1
+        assert driver.host_manager.blacklist.is_blacklisted("hB")
+        assignments = driver.current_assignments()
+        assert all(s.hostname == "hA" for s in assignments)
+        assert len(assignments) == 2  # shrank to hA's slots
+        # survivors keep their (host, local_rank) slots
+        ranks = {(s.hostname, s.local_rank): s.rank for s in assignments}
+        assert ("hA", 0) in ranks and ("hA", 1) in ranks
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+def test_driver_reset_limit_stops():
+    driver, rdv, disc = _make_driver({"hA": 1, "hB": 1, "hC": 1}, 1, 3,
+                                     reset_limit=1, cooldown_range=None)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        workers.finish("hA", 0, 1)  # failure 1 -> reset 1 (at limit)
+        time.sleep(0.3)
+        workers.finish("hB", 0, 1)  # failure 2 -> exceeds reset limit
+        deadline = time.time() + 5
+        while driver.error_message is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.error_message is not None
+        assert "Reset limit" in driver.error_message
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+def test_driver_waits_for_min_slots_timeout():
+    driver, rdv, disc = _make_driver({}, 2, 2, timeout=0.5)
+    with pytest.raises(RuntimeError, match="Timed out waiting"):
+        driver.start(lambda s, e, v: 0)
+    rdv.stop()
+
+
+def test_driver_scale_up_bumps_version():
+    driver, rdv, disc = _make_driver({"hA": 1}, 1, 2)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        v1 = driver.world_version
+        disc._hosts["hB"] = 1  # new host appears
+        deadline = time.time() + 5
+        while driver.world_version == v1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.world_version > v1
+        assert len(driver.current_assignments()) == 2
+        upd = json.loads(rdv.get("discovery", "update"))
+        assert upd["version"] >= v1
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+@pytest.mark.integration
+def test_elastic_cli_end_to_end(tmp_path):
+    """horovodrun --host-discovery-script with real worker processes
+    (elastic_common.py analog, happy path)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho localhost:2\n")
+    script.chmod(0o755)
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os\n"
+        "assert os.environ['HOROVOD_ELASTIC'] == '1'\n"
+        "assert 'HOROVOD_RANK' in os.environ\n"
+        "print('ELASTIC_WORKER_OK', os.environ['HOROVOD_RANK'])\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "2", "--max-np", "2",
+         "--host-discovery-script", str(script),
+         sys.executable, str(worker)],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ELASTIC_WORKER_OK 0" in proc.stdout
+    assert "ELASTIC_WORKER_OK 1" in proc.stdout
+
+
+def test_concurrent_failures_coalesce_to_one_reset():
+    """All slots of a dead host failing at once = ONE reshape (review
+    finding: reset limit counts world reconfigurations)."""
+    driver, rdv, disc = _make_driver({"hA": 2, "hB": 4}, 2, 6,
+                                     reset_limit=1, cooldown_range=None)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        # all 4 of hB's workers fail "simultaneously"
+        for s in range(4):
+            workers.finish("hB", s, 1)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                driver.registry.reset_count == 0:
+            time.sleep(0.05)
+        time.sleep(0.5)  # let any (wrong) extra resumes land
+        assert driver.registry.reset_count <= 2  # not 4
+        assert driver.error_message is None or \
+            "Reset limit" not in (driver.error_message or "")
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+def test_host_removal_triggers_reactivation():
+    """Discovery dropping a host must reshape the world and terminate its
+    workers (review finding)."""
+    driver, rdv, disc = _make_driver({"hA": 1, "hB": 1}, 1, 2,
+                                     cooldown_range=None)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        time.sleep(0.1)
+        v1 = driver.world_version
+        del disc._hosts["hB"]
+        deadline = time.time() + 6
+        while driver.world_version == v1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.world_version > v1
+        assignments = driver.current_assignments()
+        assert all(s.hostname == "hA" for s in assignments)
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+def test_notification_seq_monotonic():
+    driver, rdv, disc = _make_driver({"hA": 1}, 1, 1)
+    workers = RecordingWorkers()
+    driver.start(workers.fn)
+    try:
+        driver._notify_workers_host_changes(1)
+        v1 = json.loads(rdv.get("discovery", "update"))["version"]
+        driver._notify_workers_host_changes(1)
+        v2 = json.loads(rdv.get("discovery", "update"))["version"]
+        assert v2 > v1  # consecutive updates never share a version
+    finally:
+        driver.stop()
+        rdv.stop()
