@@ -1,0 +1,100 @@
+"""Autotuning of runtime knobs (ParameterManager analog).
+
+Reference: horovod/common/parameter_manager.h:42-110 — with
+``HOROVOD_AUTOTUNE=1`` the ParameterManager explores tunables (fusion buffer
+threshold, cycle time, response cache on/off, hierarchical ops) during
+warm-up, scoring each sample by observed bytes/sec, converges, then freezes;
+rank 0 tunes and broadcasts (``SynchronizeParameters``); samples optionally
+logged to ``HOROVOD_AUTOTUNE_LOG``.  The reference's search is Bayesian
+optimization (Gaussian process + expected improvement,
+optim/bayesian_optimization.cc).
+
+TPU build: the only knob with teeth on the compiled path is gone (XLA fuses),
+but the *eager* dispatch path keeps a real fusion threshold (how many
+gradient tensors combine into one dispatched collective —
+optimizer._allreduce_tree bucketing).  This manager tunes it with a
+categorical epsilon-free sweep + exploitation: try each candidate for
+``samples_per_candidate`` scored windows, then lock the argmax.  Simpler
+than a GP but the same contract: warm-up exploration → converge → freeze,
+CSV log, rank-0 decides (scores are deterministic per process on SPMD
+dispatch, so broadcast is unnecessary in single-controller mode and a
+byte-identical decision in multi-controller mode given synced samples).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+DEFAULT_CANDIDATES_MB = (1, 8, 32, 64, 128, 256)
+
+
+class ParameterManager:
+    def __init__(self, enabled: bool = False,
+                 candidates_mb=DEFAULT_CANDIDATES_MB,
+                 samples_per_candidate: int = 5,
+                 initial_threshold: int = 128 * 1024 * 1024,
+                 log_path: Optional[str] = None,
+                 decide_fn=None):
+        """``decide_fn(local_best_threshold) -> final_threshold``: the
+        SynchronizeParameters hook (parameter_manager.h) — in
+        multi-controller mode, rank 0's choice is published through the
+        rendezvous KV store and every rank adopts it, because per-rank
+        wall-clock scores can diverge and a divergent threshold means
+        divergent fusion buckets (mismatched collectives).  Exploration
+        itself is deterministic: the candidate schedule advances on sample
+        COUNT, identical on all ranks."""
+        self.enabled = enabled
+        self.candidates = [int(mb) * 1024 * 1024 for mb in candidates_mb]
+        self.samples_per_candidate = samples_per_candidate
+        self._scores: List[List[float]] = [[] for _ in self.candidates]
+        self._idx = 0
+        self._converged = not enabled
+        self._threshold = initial_threshold
+        self._decide_fn = decide_fn
+        self._log = open(log_path, "a") if log_path else None
+        if self._log:
+            self._log.write("candidate_bytes,score_bytes_per_sec\n")
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        if self._converged:
+            return self._threshold
+        return self.candidates[self._idx]
+
+    @property
+    def converged(self) -> bool:
+        return self._converged
+
+    def record_sample(self, nbytes: int, seconds: float) -> None:
+        """Score one dispatch window (bytes moved / wall time) against the
+        currently-explored candidate (parameter_manager Update/Tune)."""
+        if self._converged or seconds <= 0:
+            return
+        score = nbytes / seconds
+        self._scores[self._idx].append(score)
+        if self._log:
+            self._log.write(f"{self.candidates[self._idx]},{score:.1f}\n")
+            self._log.flush()
+        if len(self._scores[self._idx]) >= self.samples_per_candidate:
+            self._idx += 1
+            if self._idx >= len(self.candidates):
+                self._finalize()
+
+    def _finalize(self) -> None:
+        means = [sum(s) / len(s) if s else 0.0 for s in self._scores]
+        best = max(range(len(means)), key=lambda i: means[i])
+        local_choice = self.candidates[best]
+        if self._decide_fn is not None:
+            self._threshold = self._decide_fn(local_choice)
+        else:
+            self._threshold = local_choice
+        self._converged = True
+        if self._log:
+            self._log.write(f"# converged threshold={self._threshold}\n")
+            self._log.flush()
+
+    def close(self):
+        if self._log:
+            self._log.close()
+            self._log = None

@@ -43,6 +43,7 @@ class _GlobalState:
         self.process_set_table = None
         self.eager_engine = None
         self.timeline = None
+        self.param_manager = None
         self.elastic_enabled = False
 
 
@@ -117,6 +118,37 @@ def init(comm: Optional[Sequence[int]] = None,
         if process_sets:
             for ps in process_sets:
                 _state.process_set_table.register(ps)
+        from .autotune import ParameterManager
+
+        def _synced_decision(local_choice: int) -> int:
+            """SynchronizeParameters: rank 0's converged threshold wins
+            everywhere (rank-divergent thresholds would produce divergent
+            fusion buckets → mismatched collectives)."""
+            addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+            port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+            if topo.size <= 1 or topo.emulated or not addr or not port:
+                return local_choice
+            import json as _json
+            import time as _time
+            from .runner.http_server import KVStoreClient
+            client = KVStoreClient(addr, int(port))
+            if topo.rank == 0:
+                client.put("autotune", "threshold",
+                           _json.dumps({"threshold": local_choice}).encode())
+                return local_choice
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                raw = client.get("autotune", "threshold")
+                if raw is not None:
+                    return int(_json.loads(raw)["threshold"])
+                _time.sleep(0.05)
+            return local_choice
+
+        _state.param_manager = ParameterManager(
+            enabled=cfg.autotune,
+            initial_threshold=cfg.fusion_threshold_bytes,
+            log_path=cfg.autotune_log if topo.rank == 0 else None,
+            decide_fn=_synced_decision)
         if cfg.timeline_path and topo.rank == 0:
             # Rank 0 writes the trace, like the reference coordinator
             # (HOROVOD_TIMELINE, operations.cc:1077).

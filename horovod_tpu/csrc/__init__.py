@@ -1,0 +1,292 @@
+"""ctypes bindings for the native control-plane core (libhvdcore.so).
+
+The reference exposes its C++ core through an ``extern "C"`` surface consumed
+by ctypes (horovod/common/basics.py:29 HorovodBasics); this module is the
+same pattern: build-on-first-import (Makefile, g++), load with ctypes, wrap
+in small Python classes.  See csrc/hvd_core.cc for what lives natively and
+why.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libhvdcore.so")
+_lib = None
+_lock = threading.Lock()
+
+
+def _build() -> None:
+    """Build under an exclusive file lock: N freshly-launched workers race
+    on first import; exactly one runs make (which itself writes via temp +
+    rename), the rest wait and load the finished library."""
+    import fcntl
+    lock_path = os.path.join(_DIR, ".build.lock")
+    with open(lock_path, "w") as lock_fh:
+        fcntl.flock(lock_fh, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(_SO):
+                subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                               capture_output=True)
+        finally:
+            fcntl.flock(lock_fh, fcntl.LOCK_UN)
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if needed) the native core."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            _build()
+        l = ctypes.CDLL(_SO)
+        # Signatures.
+        l.hvd_core_abi_version.restype = ctypes.c_int
+        sig_args = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                    ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                    ctypes.c_int]
+        l.hvd_cache_create.restype = ctypes.c_void_p
+        l.hvd_cache_create.argtypes = [ctypes.c_int64]
+        l.hvd_cache_destroy.argtypes = [ctypes.c_void_p]
+        l.hvd_cache_lookup.restype = ctypes.c_int
+        l.hvd_cache_lookup.argtypes = sig_args
+        l.hvd_cache_put.restype = ctypes.c_int64
+        l.hvd_cache_put.argtypes = sig_args
+        l.hvd_cache_invalidate.restype = ctypes.c_int
+        l.hvd_cache_invalidate.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_cache_clear.argtypes = [ctypes.c_void_p]
+        l.hvd_cache_size.restype = ctypes.c_int64
+        l.hvd_cache_size.argtypes = [ctypes.c_void_p]
+
+        l.hvd_msgtable_create.restype = ctypes.c_void_p
+        l.hvd_msgtable_create.argtypes = [ctypes.c_int]
+        l.hvd_msgtable_destroy.argtypes = [ctypes.c_void_p]
+        l.hvd_msgtable_set_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        l.hvd_msgtable_increment.restype = ctypes.c_int
+        l.hvd_msgtable_increment.argtypes = sig_args + [ctypes.c_int]
+        l.hvd_msgtable_validate.restype = ctypes.c_char_p
+        l.hvd_msgtable_validate.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_msgtable_erase.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_msgtable_pending.restype = ctypes.c_char_p
+        l.hvd_msgtable_pending.argtypes = [ctypes.c_void_p]
+        l.hvd_msgtable_reported_ranks.restype = ctypes.c_char_p
+        l.hvd_msgtable_reported_ranks.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_char_p]
+
+        l.hvd_fusion_plan.restype = ctypes.c_int
+        l.hvd_fusion_plan.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int)]
+
+        l.hvd_queue_create.restype = ctypes.c_void_p
+        l.hvd_queue_destroy.argtypes = [ctypes.c_void_p]
+        l.hvd_queue_add.restype = ctypes.c_int
+        l.hvd_queue_add.argtypes = sig_args
+        l.hvd_queue_finish.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_queue_size.restype = ctypes.c_int64
+        l.hvd_queue_size.argtypes = [ctypes.c_void_p]
+        l.hvd_queue_pop.restype = ctypes.c_char_p
+        l.hvd_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+        l.hvd_stall_create.restype = ctypes.c_void_p
+        l.hvd_stall_create.argtypes = [ctypes.c_double, ctypes.c_double,
+                                       ctypes.c_int]
+        l.hvd_stall_destroy.argtypes = [ctypes.c_void_p]
+        l.hvd_stall_record.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_double]
+        l.hvd_stall_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_stall_check.restype = ctypes.c_int
+        l.hvd_stall_check.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+        _lib = l
+        return _lib
+
+
+def _sig_args(name: str, dtype: str, shape: Sequence[int], op: int,
+              prescale: float, postscale: float, ps_id: int):
+    arr = (ctypes.c_int64 * len(shape))(*shape)
+    return (name.encode(), dtype.encode(), arr, len(shape), op,
+            prescale, postscale, ps_id)
+
+
+CACHE_MISS, CACHE_HIT, CACHE_INVALID = 0, 1, 2
+
+
+class NativeResponseCache:
+    """LRU response cache (response_cache.h:45) backed by C++."""
+
+    def __init__(self, capacity: int):
+        self._l = lib()
+        self._h = self._l.hvd_cache_create(capacity)
+
+    def lookup(self, name, dtype, shape, op=0, prescale=1.0, postscale=1.0,
+               ps_id=0) -> int:
+        return self._l.hvd_cache_lookup(
+            self._h, *_sig_args(name, dtype, shape, op, prescale, postscale,
+                                ps_id))
+
+    def put(self, name, dtype, shape, op=0, prescale=1.0, postscale=1.0,
+            ps_id=0) -> int:
+        return self._l.hvd_cache_put(
+            self._h, *_sig_args(name, dtype, shape, op, prescale, postscale,
+                                ps_id))
+
+    def invalidate(self, name: str) -> bool:
+        return bool(self._l.hvd_cache_invalidate(self._h, name.encode()))
+
+    def clear(self):
+        self._l.hvd_cache_clear(self._h)
+
+    def __len__(self):
+        return self._l.hvd_cache_size(self._h)
+
+    def __del__(self):
+        try:
+            self._l.hvd_cache_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeMessageTable:
+    """Coordinator negotiation table (controller.cc:1115)."""
+
+    def __init__(self, world_size: int):
+        self._l = lib()
+        self._h = self._l.hvd_msgtable_create(world_size)
+
+    def set_size(self, size: int):
+        self._l.hvd_msgtable_set_size(self._h, size)
+
+    def increment(self, name, dtype, shape, op, rank, prescale=1.0,
+                  postscale=1.0, ps_id=0) -> int:
+        """0 = recorded, 1 = ready, -1 = duplicate from this rank."""
+        return self._l.hvd_msgtable_increment(
+            self._h, *_sig_args(name, dtype, shape, op, prescale, postscale,
+                                ps_id), rank)
+
+    def validate(self, name: str) -> str:
+        """'' when consistent across ranks; else the error text
+        (ConstructResponse error checking)."""
+        return self._l.hvd_msgtable_validate(self._h,
+                                             name.encode()).decode()
+
+    def erase(self, name: str):
+        self._l.hvd_msgtable_erase(self._h, name.encode())
+
+    def pending(self) -> List[str]:
+        raw = self._l.hvd_msgtable_pending(self._h).decode()
+        return raw.split("\n") if raw else []
+
+    def reported_ranks(self, name: str) -> List[int]:
+        raw = self._l.hvd_msgtable_reported_ranks(
+            self._h, name.encode()).decode()
+        return [int(r) for r in raw.split(",")] if raw else []
+
+    def __del__(self):
+        try:
+            self._l.hvd_msgtable_destroy(self._h)
+        except Exception:
+            pass
+
+
+def plan_fusion(entries: Sequence[Tuple[str, str, int, int, int]],
+                threshold_bytes: int) -> List[List[int]]:
+    """Fusion buckets (controller.cc:901 FuseResponses).
+
+    entries: (name, dtype, bytes, op, process_set_id) per tensor, in
+    submission order.  Returns lists of entry indices per bucket."""
+    l = lib()
+    n = len(entries)
+    if n == 0:
+        return []
+    names = (ctypes.c_char_p * n)(*[e[0].encode() for e in entries])
+    dtypes = (ctypes.c_char_p * n)(*[e[1].encode() for e in entries])
+    nbytes = (ctypes.c_int64 * n)(*[e[2] for e in entries])
+    ops = (ctypes.c_int * n)(*[e[3] for e in entries])
+    ps = (ctypes.c_int * n)(*[e[4] for e in entries])
+    out = (ctypes.c_int * n)()
+    nb = l.hvd_fusion_plan(names, dtypes, nbytes, ops, ps, n,
+                           threshold_bytes, out)
+    buckets: List[List[int]] = [[] for _ in range(nb)]
+    for i in range(n):
+        buckets[out[i]].append(i)
+    return buckets
+
+
+class NativeTensorQueue:
+    """Thread-safe pending-op queue (tensor_queue.h:28)."""
+
+    def __init__(self):
+        self._l = lib()
+        self._h = self._l.hvd_queue_create()
+
+    def add(self, name, dtype, shape, op=0, prescale=1.0, postscale=1.0,
+            ps_id=0) -> bool:
+        """False on duplicate in-flight name (DUPLICATE_NAME_ERROR)."""
+        return bool(self._l.hvd_queue_add(
+            self._h, *_sig_args(name, dtype, shape, op, prescale, postscale,
+                                ps_id)))
+
+    def finish(self, name: str):
+        self._l.hvd_queue_finish(self._h, name.encode())
+
+    def pop(self, max_items: int = 64) -> List[str]:
+        raw = self._l.hvd_queue_pop(self._h, max_items).decode()
+        return raw.split("\n") if raw else []
+
+    def __len__(self):
+        return self._l.hvd_queue_size(self._h)
+
+    def __del__(self):
+        try:
+            self._l.hvd_queue_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeStallInspector:
+    """Stalled-collective detector (stall_inspector.h:30)."""
+
+    OK, WARN, SHUTDOWN = 0, 1, 2
+
+    def __init__(self, warning_time_s: float = 60.0,
+                 shutdown_time_s: float = 0.0, world_size: int = 1):
+        self._l = lib()
+        self._h = self._l.hvd_stall_create(warning_time_s, shutdown_time_s,
+                                           world_size)
+
+    def record_request(self, name: str, rank: int, now: float):
+        self._l.hvd_stall_record(self._h, name.encode(), rank, now)
+
+    def record_done(self, name: str):
+        self._l.hvd_stall_done(self._h, name.encode())
+
+    def check(self, now: float):
+        """Returns (status, [(name, waited_s, ready_ranks, missing_ranks)])."""
+        report = ctypes.c_char_p()
+        status = self._l.hvd_stall_check(self._h, now, ctypes.byref(report))
+        out = []
+        raw = (report.value or b"").decode()
+        for line in raw.splitlines():
+            name, waited, ready, missing = line.split(";")
+            out.append((name, float(waited),
+                        [int(r) for r in ready.split(",") if r],
+                        [int(r) for r in missing.split(",") if r]))
+        return status, out
+
+    def __del__(self):
+        try:
+            self._l.hvd_stall_destroy(self._h)
+        except Exception:
+            pass

@@ -120,7 +120,7 @@ def allreduce(tensor,
     out = eng.run("allreduce",
                   body, [tensor],
                   (int(rop), members, prescale_factor, postscale_factor),
-                  single, name=name)[0]
+                  single, name=name, op_id=int(rop))[0]
     return compression.decompress(out, ctx)
 
 

@@ -88,7 +88,8 @@ class EagerEngine:
         self.handles = HandleManager()
         self._exec_cache: Dict[Tuple, Any] = {}
         self._eager_mesh: Optional[Mesh] = None
-        self._names_in_flight = set()
+        self._queue = None       # native TensorQueue (duplicate detection)
+        self._negotiator = None  # multi-controller negotiation endpoint
 
     # -- mode helpers -------------------------------------------------------
 
@@ -179,7 +180,8 @@ class EagerEngine:
     def run(self, kind: str, body, tensors: List[jax.Array],
             static_params: Tuple, single_rank_fn,
             name: Optional[str] = None,
-            stacked: Optional[bool] = None) -> List[jax.Array]:
+            stacked: Optional[bool] = None,
+            op_id: int = 0) -> List[jax.Array]:
         """Dispatch one eager collective; returns per-rank outputs
         (stacked in emulated mode, local otherwise).
 
@@ -188,7 +190,19 @@ class EagerEngine:
         (common.h:239), and named ops get timeline lifecycle events."""
         from .. import core as _core
         tl = _core._state.timeline
-        label = name or kind
+        # Unnamed ops get a stable signature-derived label: distinct unnamed
+        # collectives must not share one negotiation/cache key (they would
+        # alternately invalidate each other), and per-call counters would
+        # defeat the response cache across steps.  The reference frameworks
+        # auto-name by parameter; shape+dtype fingerprinting is the eager
+        # equivalent.
+        if name is None:
+            fp = "-".join(
+                f"{jnp.asarray(t).dtype}x{'x'.join(map(str, jnp.asarray(t).shape))}"
+                for t in tensors) if tensors else "none"
+            label = f"{kind}.noname.{fp}"
+        else:
+            label = name
         self.claim_name(name)
         try:
             if tl is not None:
@@ -222,7 +236,26 @@ class EagerEngine:
                     if uniform and not any(p[1] for p in pairs):
                         return [o[0] for o in outs]
                     return list(outs)
-                # Multi-process: global stacked arrays over per-process mesh.
+                # Multi-process: negotiate first (coordinator/worker
+                # contract, controller.cc:74) so mismatched order/shape
+                # fails loudly instead of deadlocking ICI.
+                neg = self.negotiator
+                if neg.enabled and tensors:
+                    # Combined signature over ALL tensors: a mismatch in any
+                    # member of a grouped collective must fail validation
+                    # (controller.cc:496), not just tensors[0].
+                    ts_arr = [jnp.asarray(t) for t in tensors]
+                    dtype_sig = ",".join(str(t.dtype) for t in ts_arr)
+                    ragged_dim0 = kind.startswith("allgather")
+                    shape_sig = []
+                    for t in ts_arr:
+                        shape_sig.append(t.ndim)
+                        dims = list(t.shape)
+                        if ragged_dim0 and dims:
+                            dims[0] = -1  # allgatherv: dim0 may differ
+                        shape_sig.extend(dims)
+                    neg.negotiate(label, kind, dtype_sig, tuple(shape_sig),
+                                  op_id, timeline=tl)
                 mesh = self._multiproc_mesh()
                 global_ts = [self._to_global(t) for t in tensors]
                 outs = self._stacked_run(kind, body, global_ts, static_params,
@@ -236,19 +269,38 @@ class EagerEngine:
         finally:
             self.release_name(name)
 
-    # -- name bookkeeping (DUPLICATE_NAME_ERROR, common.h:239) --------------
+    # -- native core hooks ----------------------------------------------------
+
+    @property
+    def queue(self):
+        """Native TensorQueue (tensor_queue.h:28): duplicate in-flight name
+        detection in the C++ core."""
+        if self._queue is None:
+            from ..csrc import NativeTensorQueue
+            self._queue = NativeTensorQueue()
+        return self._queue
+
+    @property
+    def negotiator(self):
+        """Multi-controller negotiation endpoint (ops/negotiation.py);
+        enabled only in multi-process runs launched with a rendezvous."""
+        if self._negotiator is None:
+            from .. import core as _core
+            from .negotiation import Negotiator
+            self._negotiator = Negotiator(self.topo.rank, self.topo.size,
+                                          _core._state.config)
+        return self._negotiator
 
     def claim_name(self, name: Optional[str]):
         if name is None:
             return None
         from ..exceptions import DuplicateNameError
-        if name in self._names_in_flight:
+        if not self.queue.add(name, "", []):
             raise DuplicateNameError(
                 f"collective named {name!r} already in flight "
                 f"(reference: DUPLICATE_NAME_ERROR, common.h:239)")
-        self._names_in_flight.add(name)
         return name
 
     def release_name(self, name: Optional[str]):
         if name is not None:
-            self._names_in_flight.discard(name)
+            self.queue.finish(name)

@@ -1,0 +1,245 @@
+"""Multi-controller eager negotiation — the reference's coordinator/worker
+protocol, C++ logic + HTTP-KV transport.
+
+Why this exists (SURVEY.md §7 "hard parts"): on the eager path, each process
+issues collectives in whatever order its Python code reaches them.  If two
+ranks disagree on order (or on a tensor's shape/dtype), the compiled XLA
+collectives deadlock on ICI with no diagnosis.  The reference solves this
+with rank-0 negotiation (controller.cc:74): every rank announces readiness,
+rank 0 validates consistency (ConstructResponse, controller.cc:496) and
+broadcasts the verdict; a ResponseCache (response_cache.h:45) skips the
+round-trip for tensors already negotiated; a StallInspector
+(stall_inspector.h:30) reports which ranks are missing when a collective
+stalls >60 s.
+
+The *logic* (message table, response cache, stall inspector) is the native
+core (csrc/hvd_core.cc); this module supplies the transport: requests and
+verdicts travel through the launcher's rendezvous KV store (the Gloo HTTP
+store pattern) instead of MPI_Gatherv/Bcast.  The compiled (jit) path never
+enters here — under jit, issue order is program order and XLA enforces it
+(the reference itself disables cycling for its XLA path,
+operations.cc:528-534).
+
+Cost model: two KV round-trips per *new* tensor signature; repeat
+submissions hit the native response cache and dispatch immediately, which is
+the same steady-state the reference reaches via its bitvector fast path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import config as _config
+from ..exceptions import HorovodInternalError, DuplicateNameError
+from ..utils import get_logger
+
+# Op-kind ids for cross-rank match checking; allgather-family ids are >= 100
+# (the native Validate() relaxes dim0 matching for those).
+KIND_IDS = {
+    "allreduce": 0,        # + ReduceOp enum value is folded into params
+    "grouped_allreduce": 1,
+    "broadcast": 10,
+    "alltoall": 20,
+    "reducescatter": 30,
+    "barrier": 40,
+    "allgather": 100,
+    "allgather_sizes": 101,
+}
+
+
+class Negotiator:
+    """Per-process negotiation endpoint.  Rank 0 doubles as coordinator."""
+
+    def __init__(self, rank: int, size: int, cfg):
+        self.rank = rank
+        self.size = size
+        self.cfg = cfg
+        addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+        port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+        self.enabled = (size > 1 and addr is not None and port is not None)
+        if not self.enabled:
+            return
+        from ..csrc import (NativeMessageTable, NativeResponseCache,
+                            NativeStallInspector, CACHE_HIT, CACHE_INVALID)
+        from ..runner.http_server import KVStoreClient
+        self._HIT, self._INVALID = CACHE_HIT, CACHE_INVALID
+        self.client = KVStoreClient(addr, int(port))
+        self.cache = NativeResponseCache(cfg.cache_capacity)
+        self.msgtable = NativeMessageTable(size) if rank == 0 else None
+        self.stall = NativeStallInspector(
+            cfg.stall_warning_time_seconds if cfg.stall_check_enabled
+            else float("inf"),
+            cfg.stall_shutdown_time_seconds, size)
+        self._epochs: Dict[str, int] = {}
+        self._inval_seen = 0  # last observed cross-rank invalidation seq
+        self._timeout = float(os.environ.get(
+            _config.HOROVOD_GLOO_TIMEOUT_SECONDS, "300"))
+
+    # -- protocol -------------------------------------------------------------
+
+    def negotiate(self, name: str, kind: str, dtype: str,
+                  shape: Tuple[int, ...], op: int = 0,
+                  prescale: float = 1.0, postscale: float = 1.0,
+                  ps_id: int = 0, timeline=None) -> None:
+        """Block until every rank has announced this collective and rank 0
+        validated consistency; raises HorovodInternalError on mismatch.
+
+        Fast path: response-cache HIT dispatches immediately with no
+        traffic."""
+        if not self.enabled:
+            return
+        kind_id = KIND_IDS.get(kind, 0) + (op if kind == "allreduce" else 0)
+        self._absorb_remote_invalidations()
+        status = self.cache.lookup(name, dtype, shape, kind_id, prescale,
+                                   postscale, ps_id)
+        if status == self._HIT:
+            return
+        if status == self._INVALID:
+            # Shape/param change: renegotiate under a fresh epoch AND tell
+            # every other rank, whose cached HIT would otherwise dispatch
+            # straight into a mismatched collective (the reference keeps
+            # cache coherence with a per-cycle bitvector AND,
+            # controller.cc:845 CoordinateCacheAndState; here an
+            # invalidation marker in the KV store plays that role).
+            self.cache.invalidate(name)
+            self._publish_invalidation(name)
+        epoch = self._epochs.get(name, 0)
+        self._epochs[name] = epoch + 1
+        scope = "negotiate"
+        req_key = f"req/{name}/{epoch}/{self.rank}"
+        resp_key = f"resp/{name}/{epoch}"
+        sig = {"dtype": dtype, "shape": list(shape), "op": kind_id,
+               "prescale": prescale, "postscale": postscale, "ps_id": ps_id}
+        if timeline is not None:
+            timeline.negotiate_start(name, kind.upper())
+        self.client.put(scope, req_key, json.dumps(sig).encode())
+        try:
+            if self.rank == 0:
+                if epoch > 0:
+                    # GC the previous epoch's verdict: everyone who needed it
+                    # has moved on to this epoch (KV stays O(names x size)).
+                    try:
+                        self.client.delete(scope, f"resp/{name}/{epoch - 1}")
+                    except Exception:
+                        pass
+                self._coordinate(name, epoch, sig, timeline)
+            verdict = self._wait_response(name, resp_key)
+            # Own request record is consumed; drop it.
+            try:
+                self.client.delete(scope, req_key)
+            except Exception:
+                pass
+        finally:
+            if timeline is not None:
+                timeline.negotiate_end(name, kind.upper())
+        if verdict:
+            raise HorovodInternalError(
+                f"collective {name!r} rejected by coordinator: {verdict}")
+        self.cache.put(name, dtype, shape, kind_id, prescale, postscale,
+                       ps_id)
+
+    # -- cross-rank cache invalidation ---------------------------------------
+
+    def _publish_invalidation(self, name: str) -> None:
+        seq = self._inval_seen + 1
+        self._inval_seen = seq
+        self.client.put("negotiate", f"inval/{self.rank}",
+                        json.dumps({"seq": seq, "name": name}).encode())
+
+    def _absorb_remote_invalidations(self) -> None:
+        """Before trusting a cache HIT, absorb other ranks' invalidation
+        markers (one KV GET per peer per dispatch — the eager path trades a
+        millisecond for coherence; the compiled path never pays this)."""
+        for r in range(self.size):
+            if r == self.rank:
+                continue
+            raw = self.client.get("negotiate", f"inval/{r}")
+            if raw is None:
+                continue
+            rec = json.loads(raw)
+            if rec["seq"] > getattr(self, f"_inval_seen_{r}", 0):
+                setattr(self, f"_inval_seen_{r}", rec["seq"])
+                self.cache.invalidate(rec["name"])
+
+    def _coordinate(self, name: str, epoch: int, my_sig: dict,
+                    timeline) -> None:
+        """Rank 0: gather all ranks' requests, run the native message table,
+        publish the verdict (ComputeResponseList slow path).
+
+        The message table is keyed per (name, epoch) and unconditionally
+        erased on every exit path — an error verdict (timeout, duplicate,
+        stall shutdown) must not poison the name for the elastic retry."""
+        tbl_key = f"{name}#{epoch}"
+        deadline = time.time() + self._timeout
+        arrived = set()
+        last_stall_check = time.time()
+        try:
+            while len(arrived) < self.size:
+                for r in range(self.size):
+                    if r in arrived:
+                        continue
+                    raw = self.client.get("negotiate",
+                                          f"req/{name}/{epoch}/{r}")
+                    if raw is None:
+                        continue
+                    sig = json.loads(raw)
+                    res = self.msgtable.increment(
+                        tbl_key, sig["dtype"], sig["shape"], sig["op"], r,
+                        sig["prescale"], sig["postscale"], sig["ps_id"])
+                    if res == -1:
+                        self._publish(name, epoch,
+                                      f"duplicate request from rank {r} "
+                                      f"(DUPLICATE_NAME_ERROR)")
+                        return
+                    arrived.add(r)
+                    self.stall.record_request(tbl_key, r, time.time())
+                    if timeline is not None:
+                        timeline.negotiate_rank_ready(name, r)
+                now = time.time()
+                if now - last_stall_check > 1.0:
+                    last_stall_check = now
+                    st, report = self.stall.check(now)
+                    if st >= 1:
+                        for tname, waited, ready, missing in report:
+                            get_logger().warning(
+                                "Stalled collective %s: waited %.0fs; ready "
+                                "ranks %s; missing ranks %s "
+                                "(HOROVOD_STALL_CHECK_TIME_SECONDS)",
+                                tname.split("#")[0], waited, ready, missing)
+                    if st == 2:
+                        self._publish(name, epoch,
+                                      "stall shutdown threshold exceeded")
+                        return
+                if now > deadline:
+                    self._publish(
+                        name, epoch,
+                        f"negotiation timed out; arrived={sorted(arrived)}")
+                    return
+                if len(arrived) < self.size:
+                    time.sleep(0.01)
+            # Native validation errors embed the epoch-scoped table key;
+            # surface the user-facing name instead.
+            self._publish(name, epoch,
+                          self.msgtable.validate(tbl_key).replace(tbl_key,
+                                                                  name))
+        finally:
+            self.stall.record_done(tbl_key)
+            self.msgtable.erase(tbl_key)
+
+    def _publish(self, name: str, epoch: int, err: str) -> None:
+        self.client.put("negotiate", f"resp/{name}/{epoch}",
+                        json.dumps({"error": err}).encode())
+
+    def _wait_response(self, name: str, resp_key: str) -> str:
+        deadline = time.time() + self._timeout
+        while time.time() < deadline:
+            raw = self.client.get("negotiate", resp_key)
+            if raw is not None:
+                return json.loads(raw).get("error", "")
+            time.sleep(0.005)
+        raise HorovodInternalError(
+            f"timed out waiting for negotiation verdict on {name!r}")

@@ -139,6 +139,37 @@ def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
                 for i, o in zip(live, out):
                     reduced[i] = o
         return jax.tree_util.tree_unflatten(treedef, reduced)
+    axis = _axis_name()
+    if not _axis_bound(axis) and len(leaves) > 1 and \
+            op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        # Eager path: each dispatch is a separate compiled collective, so
+        # bucket leaves with the native fusion planner (controller.cc:901
+        # FuseResponses) up to the fusion threshold — the Horovod tensor-
+        # fusion behavior the compiled path gets for free from XLA's
+        # combiner.  Autotune (HOROVOD_AUTOTUNE=1) scores these windows.
+        from . import core as _core
+        from .csrc import plan_fusion
+        import time as _time
+        pm = _core._state.param_manager
+        threshold = pm.fusion_threshold_bytes if pm is not None else \
+            _core._state.config.fusion_threshold_bytes
+        entries = [(str(i), str(l.dtype), int(l.size * l.dtype.itemsize),
+                    int(op), 0) for i, l in enumerate(leaves)]
+        buckets = plan_fusion(entries, threshold)
+        reduced = list(leaves)
+        t0 = _time.perf_counter()
+        total_bytes = sum(e[2] for e in entries)
+        for bucket in buckets:
+            outs = _ops.grouped_allreduce(
+                [leaves[i] for i in bucket], op=op, compression=compression,
+                prescale_factor=prescale, postscale_factor=postscale,
+                process_set=process_set)
+            for i, o in zip(bucket, outs):
+                reduced[i] = o
+        if pm is not None and pm.enabled and not pm.converged:
+            jax.block_until_ready(reduced)
+            pm.record_sample(total_bytes, _time.perf_counter() - t0)
+        return jax.tree_util.tree_unflatten(treedef, reduced)
     reduced = [
         _reduce_grad_leaf(l, op, compression, prescale, postscale,
                           process_set)

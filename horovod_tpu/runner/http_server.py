@@ -141,3 +141,9 @@ class KVStoreClient:
             if e.code == 404:
                 return None
             raise
+
+    def delete(self, scope: str, key: str) -> None:
+        import urllib.request
+        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
+                                     method="DELETE")
+        urllib.request.urlopen(req, timeout=30).read()

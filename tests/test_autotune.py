@@ -1,0 +1,51 @@
+"""Autotune / ParameterManager tests (parameter_manager.h:42-110 contract:
+explore during warm-up, converge, freeze; CSV log)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.autotune import ParameterManager
+
+
+def test_disabled_manager_is_frozen():
+    pm = ParameterManager(enabled=False, initial_threshold=64)
+    assert pm.converged
+    assert pm.fusion_threshold_bytes == 64
+    pm.record_sample(100, 1.0)  # no-op
+    assert pm.converged
+
+
+def test_sweep_converges_to_best_candidate(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(enabled=True, candidates_mb=(1, 2, 4),
+                          samples_per_candidate=2, log_path=str(log))
+    assert not pm.converged
+    # Candidate 0 scores poorly, candidate 1 best, candidate 2 middling.
+    scores = {0: 10.0, 1: 0.1, 2: 1.0}  # seconds per 1000 bytes
+    for cand in range(3):
+        for _ in range(2):
+            assert pm.fusion_threshold_bytes == [1, 2, 4][cand] * 1024 * 1024
+            pm.record_sample(1000, scores[cand])
+    assert pm.converged
+    assert pm.fusion_threshold_bytes == 2 * 1024 * 1024  # candidate 1 wins
+    content = log.read_text()
+    assert "converged threshold=2097152" in content
+    pm.close()
+
+
+def test_eager_gradient_fusion_buckets(hvd8):
+    """Eager DistributedOptimizer bucketizes leaves via the native fusion
+    planner; numerics must match leaf-by-leaf averaging."""
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    state_params = {f"p{i}": jnp.zeros((3,), jnp.float32) for i in range(5)}
+    rng = np.random.RandomState(0)
+    grads = {f"p{i}": jnp.asarray(
+        np.broadcast_to(rng.randn(3).astype(np.float32), (8, 3)).copy())
+        for i in range(5)}
+    state = opt.init(state_params)
+    updates, _ = opt.update(grads, state, state_params)
+    for k, g in grads.items():
+        np.testing.assert_allclose(
+            np.asarray(updates[k][0]), -np.asarray(g)[0], rtol=1e-5)

@@ -1,0 +1,215 @@
+"""Native C++ core tests (csrc/hvd_core.cc via ctypes).
+
+Covers the surviving host-side logic of the reference's C++ core:
+ResponseCache LRU/invalidation (response_cache.h:45), negotiation message
+table with duplicate + mismatch detection (controller.cc:496,1115), fusion
+planning with look-ahead (controller.cc:901), TensorQueue (tensor_queue.h:28)
+and StallInspector (stall_inspector.h:30).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu import csrc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_abi_version():
+    assert csrc.lib().hvd_core_abi_version() == 1
+
+
+# -- ResponseCache -----------------------------------------------------------
+
+def test_cache_miss_put_hit_invalid():
+    c = csrc.NativeResponseCache(8)
+    assert c.lookup("t", "float32", [4, 4]) == csrc.CACHE_MISS
+    bit = c.put("t", "float32", [4, 4])
+    assert bit == 0
+    assert c.lookup("t", "float32", [4, 4]) == csrc.CACHE_HIT
+    # Shape change → INVALID (forces renegotiation).
+    assert c.lookup("t", "float32", [8, 4]) == csrc.CACHE_INVALID
+    # Param change → INVALID too.
+    assert c.lookup("t", "float32", [4, 4], prescale=0.5) == \
+        csrc.CACHE_INVALID
+    assert c.invalidate("t")
+    assert c.lookup("t", "float32", [4, 4]) == csrc.CACHE_MISS
+
+
+def test_cache_lru_eviction_and_bit_reuse():
+    c = csrc.NativeResponseCache(2)
+    b0 = c.put("a", "float32", [1])
+    b1 = c.put("b", "float32", [1])
+    assert {b0, b1} == {0, 1}
+    c.lookup("a", "float32", [1])      # touch a → b becomes LRU
+    b2 = c.put("c", "float32", [1])    # evicts b, reuses its bit
+    assert b2 == b1
+    assert c.lookup("b", "float32", [1]) == csrc.CACHE_MISS
+    assert c.lookup("a", "float32", [1]) == csrc.CACHE_HIT
+    assert len(c) == 2
+
+
+def test_cache_zero_capacity_disabled():
+    c = csrc.NativeResponseCache(0)  # HOROVOD_CACHE_CAPACITY=0
+    assert c.put("t", "float32", [1]) == -1
+    assert c.lookup("t", "float32", [1]) == csrc.CACHE_MISS
+
+
+# -- MessageTable ------------------------------------------------------------
+
+def test_msgtable_ready_and_validate_ok():
+    mt = csrc.NativeMessageTable(3)
+    assert mt.increment("g", "float32", [4], 1, rank=0) == 0
+    assert mt.increment("g", "float32", [4], 1, rank=2) == 0
+    assert mt.reported_ranks("g") == [0, 2]
+    assert mt.increment("g", "float32", [4], 1, rank=1) == 1  # ready
+    assert mt.validate("g") == ""
+    mt.erase("g")
+    assert mt.pending() == []
+
+
+def test_msgtable_duplicate_rank():
+    mt = csrc.NativeMessageTable(2)
+    assert mt.increment("g", "float32", [4], 1, rank=0) == 0
+    assert mt.increment("g", "float32", [4], 1, rank=0) == -1  # duplicate
+
+
+def test_msgtable_shape_mismatch():
+    mt = csrc.NativeMessageTable(2)
+    mt.increment("g", "float32", [4], 1, rank=0)
+    mt.increment("g", "float32", [5], 1, rank=1)
+    assert "Mismatched shapes" in mt.validate("g")
+
+
+def test_msgtable_dtype_mismatch_names_ranks():
+    mt = csrc.NativeMessageTable(2)
+    mt.increment("g", "float32", [4], 1, rank=0)
+    mt.increment("g", "float16", [4], 1, rank=1)
+    err = mt.validate("g")
+    assert "Mismatched data types" in err
+    assert "float32" in err and "float16" in err
+
+
+def test_msgtable_allgather_ragged_dim0_allowed():
+    mt = csrc.NativeMessageTable(2)
+    mt.increment("g", "float32", [4, 7], 100, rank=0)  # allgather kind
+    mt.increment("g", "float32", [9, 7], 100, rank=1)
+    assert mt.validate("g") == ""
+    mt2 = csrc.NativeMessageTable(2)
+    mt2.increment("g", "float32", [4, 7], 100, rank=0)
+    mt2.increment("g", "float32", [9, 8], 100, rank=1)
+    assert "trailing" in mt2.validate("g")
+
+
+def test_msgtable_pending_order():
+    mt = csrc.NativeMessageTable(2)
+    mt.increment("b", "float32", [1], 1, rank=0)
+    mt.increment("a", "float32", [1], 1, rank=0)
+    assert mt.pending() == ["b", "a"]  # arrival order, not alphabetical
+
+
+# -- Fusion planner ----------------------------------------------------------
+
+def test_fusion_threshold_and_lookahead():
+    entries = [
+        ("g0", "float32", 100, 1, 0),
+        ("g1", "float16", 80, 1, 0),   # different dtype: skipped (look-ahead)
+        ("g2", "float32", 120, 1, 0),  # fuses with g0 (220 <= 256)
+        ("g3", "float32", 50, 1, 0),   # 270 > 256 → next bucket
+        ("g4", "float16", 60, 1, 0),   # fuses with g1
+    ]
+    buckets = csrc.plan_fusion(entries, threshold_bytes=256)
+    assert [sorted(b) for b in buckets] == [[0, 2], [1, 4], [3]]
+
+
+def test_fusion_respects_process_set_and_op():
+    entries = [
+        ("a", "float32", 10, 1, 0),
+        ("b", "float32", 10, 2, 0),  # different op
+        ("c", "float32", 10, 1, 5),  # different process set
+        ("d", "float32", 10, 1, 0),  # fuses with a
+    ]
+    buckets = csrc.plan_fusion(entries, threshold_bytes=1000)
+    assert [sorted(b) for b in buckets] == [[0, 3], [1], [2]]
+
+
+def test_fusion_empty():
+    assert csrc.plan_fusion([], 128) == []
+
+
+# -- TensorQueue -------------------------------------------------------------
+
+def test_tensor_queue_duplicate_and_fifo():
+    q = csrc.NativeTensorQueue()
+    assert q.add("x", "float32", [4])
+    assert not q.add("x", "float32", [4])  # duplicate in flight
+    assert q.add("y", "float32", [4])
+    assert len(q) == 2
+    assert q.pop(10) == ["x", "y"]
+    q.finish("x")
+    assert q.add("x", "float32", [4])  # finished → name reusable
+
+
+# -- StallInspector ----------------------------------------------------------
+
+def test_stall_inspector_warn_and_report():
+    si = csrc.NativeStallInspector(warning_time_s=1.0, shutdown_time_s=10.0,
+                                   world_size=4)
+    si.record_request("t", 0, now=0.0)
+    si.record_request("t", 2, now=0.1)
+    status, report = si.check(now=0.5)
+    assert status == si.OK  # not yet past warning time
+    status, report = si.check(now=2.0)
+    assert status == si.WARN
+    (name, waited, ready, missing), = report
+    assert name == "t" and ready == [0, 2] and missing == [1, 3]
+    status, _ = si.check(now=20.0)
+    assert status == si.SHUTDOWN
+    si.record_done("t")
+    status, report = si.check(now=30.0)
+    assert status == si.OK and report == []
+
+
+def test_stall_inspector_complete_set_not_stalled():
+    si = csrc.NativeStallInspector(1.0, 0.0, 2)
+    si.record_request("t", 0, 0.0)
+    si.record_request("t", 1, 0.0)
+    status, report = si.check(100.0)
+    assert status == si.OK  # all ranks reported → not a stall
+
+
+# -- integration: negotiation catches cross-rank mismatch --------------------
+
+MISMATCH_WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd
+import jax.numpy as jnp
+hvd.init()
+shape = 4 if hvd.rank() == 0 else 5   # deliberate cross-rank mismatch
+try:
+    hvd.allreduce(jnp.ones((shape,)), name="grad.fc")
+    print("NO_ERROR")
+except hvd.HorovodInternalError as e:
+    print("CAUGHT_MISMATCH:", str(e)[:80])
+"""
+
+
+@pytest.mark.integration
+def test_negotiation_rejects_shape_mismatch_across_processes(tmp_path):
+    """The whole point of the controller: a cross-rank shape mismatch must
+    produce an error response on every rank (controller.cc:496), not an ICI
+    deadlock."""
+    script = tmp_path / "mismatch.py"
+    script.write_text(MISMATCH_WORKER.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert proc.stdout.count("CAUGHT_MISMATCH") == 2, \
+        proc.stdout + proc.stderr
+    assert "Mismatched shapes" in proc.stdout
