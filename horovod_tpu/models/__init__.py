@@ -9,3 +9,8 @@ BASELINE.json north-star configs.
 from .resnet import (  # noqa: F401
     ResNet, ResNet50, ResNet101, ResNet152, create_resnet50,
 )
+
+from .transformer import (  # noqa: F401
+    Transformer, TransformerConfig, create_gpt2, create_bert, lm_loss,
+    GPT2_SMALL, GPT2_MEDIUM, GPT2_LARGE, BERT_BASE, BERT_LARGE,
+)

@@ -1,0 +1,160 @@
+"""Transformer family (GPT-2 / BERT) — TPU-first flax implementation.
+
+Reference analog: the BASELINE.json north-star configs train BERT-large
+(PyTorch DistributedOptimizer + gradient accumulation) and GPT-2 medium with
+Adasum; the reference itself ships no model code beyond examples.  These
+models are written for the MXU: bfloat16 matmuls with float32 layernorm/
+softmax/loss islands, d_model/d_ff multiples of 128, optional
+``jax.checkpoint`` rematerialization per block (HBM for FLOPs), and a
+pluggable attention backend:
+
+* ``seq_parallel=None``      — dense local attention (data-parallel only);
+* ``seq_parallel='ring'``    — ring attention over the mesh axis
+                               (parallel/ring.py), sequence sharded;
+* ``seq_parallel='ulysses'`` — all_to_all head<->sequence exchange
+                               (parallel/ulysses.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from ..parallel.ring import ring_attention, ring_attention_reference
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_len: int = 1024
+    causal: bool = True              # GPT style; False = BERT style
+    dtype: Any = jnp.bfloat16
+    axis_name: str = "hvd"
+    seq_parallel: Optional[str] = None   # None | 'ring' | 'ulysses'
+    remat: bool = False
+
+
+# Benchmark-standard configurations.
+GPT2_SMALL = TransformerConfig(num_layers=12, num_heads=12, d_model=768,
+                               d_ff=3072)
+GPT2_MEDIUM = TransformerConfig(num_layers=24, num_heads=16, d_model=1024,
+                                d_ff=4096)
+GPT2_LARGE = TransformerConfig(num_layers=36, num_heads=20, d_model=1280,
+                               d_ff=5120)
+BERT_BASE = TransformerConfig(vocab_size=30522, num_layers=12, num_heads=12,
+                              d_model=768, d_ff=3072, max_len=512,
+                              causal=False)
+BERT_LARGE = TransformerConfig(vocab_size=30522, num_layers=24, num_heads=16,
+                               d_model=1024, d_ff=4096, max_len=512,
+                               causal=False)
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        head_dim = cfg.d_model // cfg.num_heads
+        dense = partial(nn.DenseGeneral, dtype=cfg.dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        qkv = dense(features=(3, cfg.num_heads, head_dim), axis=-1,
+                    name="qkv")(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, Dh]
+        if cfg.seq_parallel == "ring":
+            out = ring_attention(q, k, v, axis_name=cfg.axis_name,
+                                 causal=cfg.causal)
+        elif cfg.seq_parallel == "ulysses":
+            out = ulysses_attention(q, k, v, axis_name=cfg.axis_name,
+                                    causal=cfg.causal)
+        else:
+            out = ring_attention_reference(q, k, v, causal=cfg.causal)
+        return dense(features=cfg.d_model, axis=(-2, -1), name="proj")(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, dtype=jnp.float32, epsilon=1e-5)
+        h = ln(name="ln1")(x)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype))
+        h = ln(name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="fc1",
+                     kernel_init=nn.initializers.normal(0.02))(
+                         h.astype(cfg.dtype))
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="fc2",
+                     kernel_init=nn.initializers.normal(0.02))(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Decoder-only (causal=True, GPT) or encoder (causal=False, BERT)
+    producing token logits (LM head ties the embedding)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, positions=None):
+        cfg = self.cfg
+        B, S = tokens.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model,
+                       embedding_init=nn.initializers.normal(0.02),
+                       dtype=cfg.dtype, name="wte")
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+            if cfg.seq_parallel is not None:
+                # Sequence-sharded: this shard holds global tokens
+                # [idx*S, (idx+1)*S) — offset the position embedding or every
+                # shard but the first would silently embed positions 0..S-1.
+                from jax import lax as _lax
+                positions = positions + _lax.axis_index(cfg.axis_name) * S
+        pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
+                           embedding_init=nn.initializers.normal(0.01),
+                           dtype=cfg.dtype, name="wpe")(positions)
+        x = emb(tokens) + pos_emb
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)  # jax.checkpoint: HBM for FLOPs
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied LM head (GPT-2 convention); f32 logits for a stable loss.
+        logits = emb.attend(x.astype(cfg.dtype)).astype(jnp.float32)
+        return logits
+
+
+def lm_loss(logits, targets, mask=None):
+    """Token cross-entropy in f32 (BERT MLM or GPT next-token; caller shifts
+    targets for causal LM)."""
+    import optax
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is not None:
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(losses)
+
+
+def create_gpt2(size: str = "medium", **overrides) -> Transformer:
+    base = {"small": GPT2_SMALL, "medium": GPT2_MEDIUM,
+            "large": GPT2_LARGE}[size]
+    return Transformer(dataclasses.replace(base, **overrides))
+
+
+def create_bert(size: str = "large", **overrides) -> Transformer:
+    base = {"base": BERT_BASE, "large": BERT_LARGE}[size]
+    return Transformer(dataclasses.replace(base, **overrides))

@@ -278,60 +278,113 @@ def DistributedOptimizer(optimizer,
                                      state.acc_grads, updates)
         counter = state.counter + 1
         sync = counter >= n
+        axis = _axis_name()
+        bound = _axis_bound(axis)
+        leaves = jax.tree_util.tree_leaves(acc)
+        all_invariant = bound and all(_is_invariant(l, axis) for l in leaves)
 
-        # Under shard_map, branch outputs must agree on varying-manual-axes:
-        # the post-allreduce values are axis-invariant while local zeros are
-        # varying — pcast everything to varying for a consistent cond.
-        def _vary(tree):
-            from . import core as _core
-            axis = (_core.mesh_axis() if _core.is_initialized() else "hvd")
-            try:
-                jax.lax.axis_index(axis)
-            except NameError:
-                return tree  # eager: no manual axes in scope
-            def cast(x):
-                vma = getattr(jax.typeof(x), "vma", frozenset())
-                if axis in vma:
-                    return x  # already varying on this axis
-                return jax.lax.pcast(x, axis, to="varying")
-
-            return jax.tree_util.tree_map(cast, tree)
-
-        def do_sync(acc_and_state):
-            acc, inner_state = acc_and_state
-            # Average over the local passes like the reference's helper
-            # (gradient_aggregation.py averages by backward_passes_per_step).
-            scaled = jax.tree_util.tree_map(lambda a: a / n, acc)
+        # Average over the local passes like the reference's helper
+        # (gradient_aggregation.py averages by backward_passes_per_step).
+        def sync_branch(acc_and_inner):
+            acc_, inner_ = acc_and_inner
+            scaled = jax.tree_util.tree_map(lambda a: a / n, acc_)
             reduced, _ = allreduce_t.update(scaled, optax.EmptyState(),
                                             params)
-            new_updates, new_inner = optimizer.update(reduced, inner_state,
-                                                      params)
-            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return _vary((new_updates, new_inner, zeroed))
+            su, si = optimizer.update(reduced, inner_, params)
+            return su, si, jax.tree_util.tree_map(jnp.zeros_like, acc_)
 
-        def no_sync(acc_and_state):
-            acc, inner_state = acc_and_state
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return _vary((zeros, inner_state, acc))
+        if all_invariant:
+            # In-trace with pre-reduced gradients: the "allreduce" is a pure
+            # division (_reduce_grad_leaf), so computing both branches and
+            # selecting with jnp.where costs no communication and keeps
+            # vma types consistent (everything invariant).
+            sync_updates, sync_inner, _ = sync_branch(
+                (acc, state.inner_state))
 
-        new_updates, new_inner, new_acc = jax.lax.cond(
-            sync, do_sync, no_sync, (acc, state.inner_state))
+            def sel(a, b):
+                return jnp.where(sync, a, b)
+
+            new_updates = jax.tree_util.tree_map(
+                lambda u, z: sel(u, jnp.zeros_like(z)), sync_updates, acc)
+            new_inner = jax.tree_util.tree_map(sel, sync_inner,
+                                               state.inner_state)
+            new_acc = jax.tree_util.tree_map(
+                lambda a: sel(jnp.zeros_like(a), a), acc)
+        else:
+            # Varying (true local) gradients or eager mode: a real collective
+            # runs on sync — gate it with lax.cond so accumulation steps stay
+            # communication-free (the whole point of
+            # backward_passes_per_step).  Branch outputs are pcast to varying
+            # for consistent cond typing.
+            def _vary(tree):
+                if not bound:
+                    return tree
+
+                def cast(x):
+                    if _is_invariant(x, axis):
+                        return jax.lax.pcast(x, axis, to="varying")
+                    return x
+
+                return jax.tree_util.tree_map(cast, tree)
+
+            def do_sync(arg):
+                return _vary(sync_branch(arg))
+
+            def no_sync(arg):
+                acc_, inner_ = arg
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc_)
+                return _vary((zeros, inner_, acc_))
+
+            new_updates, new_inner, new_acc = jax.lax.cond(
+                sync, do_sync, no_sync, (acc, state.inner_state))
         new_counter = jnp.where(sync, 0, counter)
         return new_updates, DistributedState(new_inner, new_acc, new_counter)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def local_value_and_grad(fun: Callable, **jax_kwargs):
+    """``jax.value_and_grad`` that returns genuinely LOCAL (per-slot)
+    gradients in-trace, pcasting replicated primals to varying so shard_map's
+    transpose doesn't pre-sum them.  This is what Adasum needs — it adapts
+    between sum and average from the *divergence* of per-rank gradients
+    (adasum.h:396-409), which pre-summed gradients erase."""
+    vg = jax.value_and_grad(fun, **jax_kwargs)
+
+    def wrapped(*args, **kwargs):
+        axis = _axis_name()
+        if _axis_bound(axis):
+            args = _to_varying(args, axis)
+        return vg(*args, **kwargs)
+
+    return wrapped
+
+
 def adasum_delta_step(optimizer, params, grads, opt_state,
                       process_set: ProcessSet = global_process_set):
     """Adasum on post-optimizer deltas (_DistributedAdasumOptimizer,
     torch/optimizer.py:345): apply the optimizer locally, Adasum-reduce the
-    parameter delta, add the reduced delta to the original parameters."""
+    parameter delta, add the reduced delta to the original parameters.
+
+    ``grads`` must be LOCAL per-slot gradients (use ``local_value_and_grad``
+    in-trace); Adasum over pre-summed gradients degenerates to identity.
+    Under shard_map, run the step with ``shard_step(..., check_vma=False)``:
+    the butterfly's output is equal on every slot but typed varying."""
     local_updates, new_state = optimizer.update(grads, opt_state, params)
     reduced_updates = jax.tree_util.tree_map(
         lambda u: _ops.allreduce(u, op=ReduceOp.ADASUM,
                                  process_set=process_set),
         local_updates)
+    # Stateful optimizers (adam moments etc.) updated their state from LOCAL
+    # gradients, so it diverges per rank; average it back to consistency —
+    # without this, returning the state through replicated out_specs would
+    # silently hand each rank different "replicated" buffers.
+    new_state = jax.tree_util.tree_map(
+        lambda s: _ops.allreduce(s, op=ReduceOp.AVERAGE,
+                                 process_set=process_set)
+        if isinstance(s, jax.Array) and jnp.issubdtype(
+            jnp.asarray(s).dtype, jnp.floating) else s,
+        new_state)
     new_params = optax.apply_updates(params, reduced_updates) \
         if optax is not None else jax.tree_util.tree_map(
             lambda p, u: p + u, params, reduced_updates)

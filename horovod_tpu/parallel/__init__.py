@@ -61,6 +61,7 @@ def shard_step(fn: Callable,
                out_specs=None,
                axis_name: Optional[str] = None,
                donate_argnums: Tuple[int, ...] = (),
+               check_vma: bool = True,
                ) -> Callable:
     """jit(shard_map(fn)) over the framework mesh — the SPMD step wrapper.
 
@@ -78,7 +79,11 @@ def shard_step(fn: Callable,
         if ins is None:
             ins = (P(),) + tuple(P(axis) for _ in range(nargs - 1))
         outs = out_specs if out_specs is not None else P()
-        mapped = jax.shard_map(fn, mesh=mesh, in_specs=ins, out_specs=outs)
+        # check_vma=False lets ops whose replication XLA cannot infer (e.g.
+        # the Adasum butterfly, whose result is equal on all slots but typed
+        # varying) return through replicated out_specs.
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=ins, out_specs=outs,
+                               check_vma=check_vma)
         return jax.jit(mapped, donate_argnums=donate_argnums)
 
     cache = {}

@@ -1,0 +1,127 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+The reference has **no** long-context support (SURVEY.md §5.8: no ring
+attention, no sequence sharding anywhere; its closest primitives are
+Alltoallv and an internal point-to-point).  This module is the TPU-native
+capability the survey calls out as the path to beating the reference on
+long-sequence workloads: shard the sequence dimension across the mesh and
+compute exact attention by rotating K/V blocks around the ring with
+``lax.ppermute`` — each hop is a neighbor transfer on the physical torus —
+while accumulating with an online (flash-style) softmax so nothing ever
+materializes the full [S, S] score matrix.
+
+Math: blockwise softmax accumulation (the numerically-stable streaming form)
+    m_new = max(m, rowmax(s));  corr = exp(m - m_new)
+    l_new = l * corr + rowsum(exp(s - m_new))
+    acc_new = acc * corr + exp(s - m_new) @ v
+run in float32 islands regardless of input dtype.
+
+Causal masking is block-aware: a query block at ring position i fully
+attends K/V blocks from positions < i, applies the triangular mask at
+position i, and skips (masks entirely) positions > i.  Work is uniform per
+step, as SPMD requires; the skipped blocks cost one masked matmul — the
+standard trade in SPMD ring attention (a load-balanced "striped" variant is
+a layout change on top, not a different algorithm).
+
+Layout contract: q, k, v are the *local sequence shards* ``[B, S/n, H, D]``
+inside shard_map with the sequence dimension sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_scores(q32, k32, scale):
+    # [B, Sq, H, D] x [B, Sk, H, D] -> [B, H, Sq, Sk]
+    return jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *,
+                   axis_name: str = "hvd",
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args:
+      q, k, v: local shards [B, S_local, H, D] (sequence axis 1 sharded).
+      causal: apply causal masking consistent with the *global* sequence
+        order (shard i holds tokens [i*S_local, (i+1)*S_local)).
+      scale: score scale; default 1/sqrt(D).
+
+    Returns local attention output [B, S_local, H, D] (same sharding as q).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    q32 = q.astype(jnp.float32)
+    neg_inf = jnp.float32(-1e30)
+
+    # Online-softmax state.
+    acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m = jnp.full((B, H, Sq, 1), neg_inf)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+
+    # Rotate K/V around the ring: after step t, we hold the block that
+    # originated on rank (my + t) % n.  ppermute source->dest pairs send
+    # each shard to its left neighbor (dest = src - 1 mod n), so hop t
+    # brings in blocks from increasing ring distance.
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    kv_k = k.astype(jnp.float32)
+    kv_v = v.astype(jnp.float32)
+
+    if causal:
+        iota_q = lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0)
+        iota_k = lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1)
+        tri_mask = iota_q >= iota_k  # within-block causal (equal block sizes)
+
+    for step in range(n):
+        owner = (my + step) % n  # global position of the current K/V block
+        s = _block_scores(q32, kv_k, scale)  # [B, H, Sq, Sk]
+        if causal:
+            # Block-level mask: owner < my -> full attend; owner == my ->
+            # triangular; owner > my -> fully masked.
+            full = (owner < my)
+            diag = (owner == my)
+            block_mask = jnp.where(
+                diag, tri_mask,
+                jnp.broadcast_to(full, tri_mask.shape))
+            s = jnp.where(block_mask[None, None], s, neg_inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, kv_v)
+        m = m_new
+        if step != n - 1:
+            kv_k = lax.ppermute(kv_k, axis_name, perm)
+            kv_v = lax.ppermute(kv_v, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention_reference(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None):
+    """Unsharded reference attention (for tests): q/k/v [B, S, H, D]."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        iq = lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        ik = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where((iq >= ik)[None, None], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

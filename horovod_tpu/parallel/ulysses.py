@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all sequence<->head exchange.
+
+The reference ships the building block — Alltoallv
+(collective_operations.h:199-268, which SURVEY.md §5.8 identifies as "the
+Ulysses head<->sequence exchange") — but no sequence-parallel attention.
+This module completes the pattern, TPU-native: inside a compiled step, a
+``lax.all_to_all`` re-shards [B, S/n, H, D] (sequence-sharded) into
+[B, S, H/n, D] (head-sharded), each device runs *full-sequence* attention
+over its head subset with any local kernel (including flash/Pallas), and a
+second all_to_all restores sequence sharding.  Two all_to_alls per layer ride
+the ICI torus; compute stays dense on the MXU.
+
+Constraint: num_heads must be divisible by the axis size (the DeepSpeed-
+Ulysses condition).  For longer rings than heads, compose with ring
+attention (parallel/ring.py) instead.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x: jax.Array, *, axis_name: str = "hvd") -> jax.Array:
+    """[B, S_local, H, D] -> [B, S_global, H/n, D] via all_to_all."""
+    n = lax.axis_size(axis_name)
+    B, S_loc, H, D = x.shape
+    if H % n != 0:
+        raise ValueError(
+            f"Ulysses requires heads ({H}) divisible by axis size ({n})")
+    # split heads across ranks, concat sequence shards
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x: jax.Array, *, axis_name: str = "hvd") -> jax.Array:
+    """[B, S_global, H/n, D] -> [B, S_local, H, D] (inverse exchange)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def _default_attention(q, k, v, *, causal: bool, scale: Optional[float]):
+    from .ring import ring_attention_reference
+    return ring_attention_reference(q, k, v, causal=causal, scale=scale)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      *,
+                      axis_name: str = "hvd",
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      attention_fn: Optional[Callable] = None) -> jax.Array:
+    """Exact attention for sequence-sharded q/k/v [B, S/n, H, D].
+
+    ``attention_fn(q, k, v, causal=..., scale=...)`` runs the local
+    full-sequence attention (default: dense reference; plug a Pallas flash
+    kernel here on real chips)."""
+    attention_fn = attention_fn or _default_attention
+    qh = seq_to_heads(q, axis_name=axis_name)
+    kh = seq_to_heads(k, axis_name=axis_name)
+    vh = seq_to_heads(v, axis_name=axis_name)
+    oh = attention_fn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(oh.astype(q.dtype), axis_name=axis_name)
