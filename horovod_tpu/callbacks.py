@@ -1,0 +1,145 @@
+"""Training callbacks — the Keras callback surface, framework-neutral.
+
+Reference: horovod/_keras/callbacks.py — BroadcastGlobalVariablesCallback
+(rank 0's initial variables to all), MetricAverageCallback (allreduce-average
+epoch metrics), LearningRateScheduleCallback / LearningRateWarmupCallback
+(scale + warm up the LR with world size, the "facebook 1-hour" recipe).
+
+The TPU build has no Keras dependency; these are plain objects with
+``on_train_begin`` / ``on_epoch_begin`` / ``on_epoch_end`` hooks driven by
+any training loop (see examples/), and an adapter is trivial for users who
+run Keras-style loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from . import core as _core
+from . import ops as _ops
+from . import functions as _functions
+
+
+class Callback:
+    def on_train_begin(self, state=None):
+        pass
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        pass
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state=None):
+        pass
+
+    def on_batch_begin(self, batch: int, state=None):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial parameters from root at train begin
+    (_keras/callbacks.py BroadcastGlobalVariablesCallbackImpl).  ``state``
+    must expose ``params`` (and optionally ``opt_state``)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state=None):
+        if state is None:
+            return
+        if hasattr(state, "params"):
+            state.params = _functions.broadcast_variables(
+                state.params, root_rank=self.root_rank)
+        if hasattr(state, "opt_state"):
+            state.opt_state = _functions.broadcast_optimizer_state(
+                state.opt_state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average metrics over ranks at epoch end
+    (_keras/callbacks.py MetricAverageCallbackImpl)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state=None):
+        if not logs:
+            return
+        for k, val in list(logs.items()):
+            arr = jnp.asarray(val, jnp.float32)
+            avg = _ops.allreduce(arr, op=_ops.ReduceOp.AVERAGE)
+            logs[k] = float(jnp.ravel(jnp.asarray(avg))[0])
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the LR by ``multiplier`` within [start_epoch, end_epoch)
+    (_keras/callbacks.py LearningRateScheduleCallbackImpl).  ``set_lr`` is a
+    callable the training loop provides (optax users typically close over a
+    mutable schedule scale)."""
+
+    def __init__(self, set_lr: Callable[[float], None], initial_lr: float,
+                 multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True):
+        self.set_lr = set_lr
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if callable(multiplier):
+            self.multiplier_fn = multiplier
+        else:
+            self.multiplier_fn = lambda epoch: multiplier
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        if self._in_range(epoch):
+            self.set_lr(self.initial_lr * self.multiplier_fn(epoch))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warm-up from lr to lr*size over ``warmup_epochs``
+    (_keras/callbacks.py LearningRateWarmupCallbackImpl — the linear-scaling
+    + warm-up recipe).  After warm-up the multiplier is world size."""
+
+    def __init__(self, set_lr: Callable[[float], None], initial_lr: float,
+                 warmup_epochs: int = 5, momentum_correction: bool = True,
+                 verbose: bool = False):
+        self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
+        if momentum_correction:
+            import warnings
+            warnings.warn(
+                "momentum_correction is accepted for API parity but not "
+                "applied automatically: with optax, wrap your optimizer in "
+                "optax.inject_hyperparams and rescale momentum alongside "
+                "set_lr", stacklevel=2)
+
+        def multiplier(epoch):
+            size = _core.num_slots()
+            if epoch >= warmup_epochs:
+                return float(size)
+            # epoch 0 -> exactly 1.0 (true warm start), reaching `size` at
+            # epoch == warmup_epochs (linear, the 1-hour-ImageNet recipe).
+            return 1.0 + (size - 1.0) * epoch / max(warmup_epochs, 1)
+
+        super().__init__(set_lr, initial_lr, multiplier,
+                         start_epoch=0, end_epoch=None)
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def __getattr__(self, hook):
+        if not hook.startswith("on_"):
+            raise AttributeError(hook)
+
+        def fire(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, hook)(*args, **kwargs)
+
+        return fire

@@ -1,0 +1,3 @@
+from .data_loader_base import (  # noqa: F401
+    BaseDataLoader, AsyncDataLoaderMixin, AsyncDataLoader,
+    ShardedDataLoader)

@@ -1,0 +1,111 @@
+"""Data loader base classes + sharded/prefetching loaders.
+
+Reference: horovod/data/data_loader_base.py:20 (BaseDataLoader), :48
+(AsyncDataLoaderMixin: a prefetch thread pushing batches into a bounded
+queue so the accelerator never waits on host input).  The TPU build adds
+``ShardedDataLoader``: rank-sharded iteration plus host→device prefetch of
+the *next* batch while the current step runs — the JAX double-buffering
+idiom that keeps HBM fed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+
+class BaseDataLoader:
+    """Iteration interface (data_loader_base.py:20)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch thread + bounded queue (data_loader_base.py:48).
+
+    Mix in before a BaseDataLoader subclass::
+
+        class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader): ...
+
+    ``async_loader_queue_size=0`` disables prefetch (synchronous passthrough).
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 2, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        super().__init__(*args, **kwargs)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.async_loader_queue_size <= 0:
+            return super().__iter__()
+        q: "queue.Queue" = queue.Queue(maxsize=self.async_loader_queue_size)
+        sentinel = object()
+        err: list = []
+
+        def producer():
+            try:
+                for item in super(AsyncDataLoaderMixin, self)._iterate():
+                    q.put(item)
+            except BaseException as e:  # surface on the consumer side
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="hvd-data-prefetch")
+        t.start()
+
+        def consume():
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+
+        return consume()
+
+
+class ShardedDataLoader(BaseDataLoader):
+    """Rank-sharded loader: each rank sees every ``size``-th batch starting
+    at its rank (the DistributedSampler contract), with optional device
+    prefetch of the next batch (double buffering)."""
+
+    def __init__(self, batches: Iterable[Any], rank: int = 0, size: int = 1,
+                 device_prefetch: bool = False):
+        self._batches = list(batches)
+        self.rank = rank
+        self.size = max(size, 1)
+        self.device_prefetch = device_prefetch
+
+    def __len__(self) -> int:
+        n = len(self._batches)
+        return (n - self.rank + self.size - 1) // self.size
+
+    def _iterate(self):
+        import jax
+        shard = self._batches[self.rank::self.size]
+        if not self.device_prefetch:
+            yield from shard
+            return
+        prev = None
+        for item in shard:
+            nxt = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x), item)
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
+
+
+class AsyncDataLoader(AsyncDataLoaderMixin, ShardedDataLoader):
+    """Convenience: sharded + background prefetch."""

@@ -99,6 +99,16 @@ def allreduce(tensor,
     members = _members(process_set)
     tensor, ctx = compression.compress(tensor)
     if _axis_bound(axis):
+        # HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE
+        # (nccl_operations.h:231, :253) are accepted and map to the flat
+        # lax.psum: on TPU, XLA already lowers psum with torus-native
+        # hierarchical decomposition, which is precisely what the
+        # reference's software torus approximates (SURVEY.md §7).  Routing
+        # through the explicit two-phase form here would also change the
+        # result's vma type (grouped collectives yield varying outputs) and
+        # break replicated out_specs that plain psum satisfies.  The
+        # explicit form stays available for 2-D mesh experts as
+        # collective_ops.hierarchical_allreduce.
         out = C.allreduce(tensor, rop, axis_name=axis, members=members,
                           prescale_factor=prescale_factor,
                           postscale_factor=postscale_factor)

@@ -342,6 +342,73 @@ def grouped_reducescatter(tensors: Sequence[jax.Array],
             for t in tensors]
 
 
+def hierarchical_allreduce(x: jax.Array,
+                           op: ReduceOp = ReduceOp.SUM,
+                           *,
+                           axis_name: str = "hvd",
+                           local_size: int,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0) -> jax.Array:
+    """Two-level allreduce: reduce-scatter within each node's chips, reduce
+    across nodes, allgather back within nodes.
+
+    This is the ICI/DCN-native form of the reference's
+    NCCLHierarchicalAllreduce (nccl_operations.h:231: NCCL ReduceScatter
+    intra-node → MPI allreduce across node leaders → NCCL Allgather) and
+    NCCLTorusAllreduce (nccl_operations.h:253: local/cross communicator
+    decomposition), selected by HOROVOD_HIERARCHICAL_ALLREDUCE /
+    HOROVOD_TORUS_ALLREDUCE.  On TPU the intra-node phase rides ICI and the
+    cross phase rides DCN; both phases use *equal-size* replica groups,
+    which XLA lowers natively.
+
+    Requires a homogeneous layout (axis size divisible by ``local_size``)
+    and a node-major mesh order (slots [k*L, (k+1)*L) on node k — the
+    default Mesh construction order).  Numerics identical to flat psum.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("hierarchical_allreduce supports SUM and AVERAGE")
+    n = lax.axis_size(axis_name)
+    if n % local_size != 0:
+        raise ValueError(
+            f"axis size {n} not divisible by local_size {local_size} "
+            f"(hierarchical allreduce needs a homogeneous layout)")
+    cross = n // local_size
+    if local_size == 1 or cross == 1:
+        return allreduce(x, op, axis_name=axis_name,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    local_groups = [[k * local_size + j for j in range(local_size)]
+                    for k in range(cross)]
+    cross_groups = [[j + k * local_size for k in range(cross)]
+                    for j in range(local_size)]
+    x = _apply_scale(x, prescale_factor)
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    pad = reducescatter_padded_size(flat.shape[0], local_size) - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # Phase 1: reduce-scatter inside the node (each chip owns a chunk).
+    chunk = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                             axis_index_groups=local_groups, tiled=True)
+    # Phase 2: allreduce the homogeneous chunk across nodes (same-local-rank
+    # chips form a cross group — the reference's "cross communicator").
+    # Expressed as grouped all_gather + row-sum: equivalent to a grouped
+    # psum, and supported by every backend (the CPU emulation backend lacks
+    # grouped psum lowering); XLA fuses the reduction.
+    gathered = lax.all_gather(chunk, axis_name,
+                              axis_index_groups=cross_groups, axis=0)
+    chunk = jnp.sum(gathered, axis=0).astype(chunk.dtype)
+    # Phase 3: allgather chunks back inside the node.
+    full = lax.all_gather(chunk, axis_name, axis_index_groups=local_groups,
+                          axis=0, tiled=True)
+    if pad:
+        full = full[:-pad]
+    r = full.reshape(orig_shape)
+    if op == ReduceOp.AVERAGE:
+        r = r // n if jnp.issubdtype(r.dtype, jnp.integer) else r / n
+    return _apply_scale(r, postscale_factor)
+
+
 def barrier(*, axis_name: str = "hvd") -> jax.Array:
     """Synchronization barrier (BarrierOp, collective_operations.h:335).
     In a compiled program this is a collective the schedule cannot reorder

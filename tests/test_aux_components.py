@@ -1,0 +1,203 @@
+"""Data loaders, callbacks, sparse allreduce, hierarchical allreduce."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.data import AsyncDataLoader, ShardedDataLoader
+from horovod_tpu.ops import collective_ops as C
+from tests.test_collective_ops import run_spmd
+
+N = 8
+
+
+# -- data loaders ------------------------------------------------------------
+
+def test_sharded_loader_partitions():
+    batches = list(range(10))
+    l0 = ShardedDataLoader(batches, rank=0, size=2)
+    l1 = ShardedDataLoader(batches, rank=1, size=2)
+    assert list(l0) == [0, 2, 4, 6, 8]
+    assert list(l1) == [1, 3, 5, 7, 9]
+    assert len(l0) == 5 and len(l1) == 5
+
+
+def test_async_loader_prefetch_and_order():
+    batches = [np.full((2,), i) for i in range(6)]
+    loader = AsyncDataLoader(batches, rank=0, size=1,
+                             async_loader_queue_size=2)
+    out = [int(b[0]) for b in loader]
+    assert out == [0, 1, 2, 3, 4, 5]
+    # second iteration works (fresh producer thread)
+    assert [int(b[0]) for b in loader] == [0, 1, 2, 3, 4, 5]
+
+
+def test_async_loader_propagates_errors():
+    class Bad(ShardedDataLoader):
+        def _iterate(self):
+            yield 1
+            raise RuntimeError("boom")
+
+    class AsyncBad(hvd.data.AsyncDataLoaderMixin, Bad):
+        pass
+
+    loader = AsyncBad([1, 2, 3], async_loader_queue_size=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+def test_async_disabled_passthrough():
+    loader = AsyncDataLoader(list(range(4)), rank=0, size=1,
+                             async_loader_queue_size=0)
+    assert list(loader) == [0, 1, 2, 3]
+
+
+# -- callbacks ---------------------------------------------------------------
+
+class _State:
+    pass
+
+
+def test_broadcast_callback(hvd8):
+    state = _State()
+    state.params = {"w": jnp.full((3,), 7.0)}
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(root_rank=0)
+    cb.on_train_begin(state)
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 7.0)
+
+
+def test_metric_average_callback(hvd8):
+    logs = {"loss": 2.0, "acc": 0.5}
+    hvd.callbacks.MetricAverageCallback().on_epoch_end(0, logs)
+    assert abs(logs["loss"] - 2.0) < 1e-6  # replicated value: avg = itself
+
+
+def test_lr_schedule_and_warmup(hvd8):
+    lrs = []
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        set_lr=lrs.append, initial_lr=0.1, multiplier=2.0,
+        start_epoch=1, end_epoch=3)
+    for e in range(4):
+        cb.on_epoch_begin(e)
+    assert lrs == [pytest.approx(0.2), pytest.approx(0.2)]  # epochs 1,2
+
+    lrs2 = []
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        warm = hvd.callbacks.LearningRateWarmupCallback(
+            set_lr=lrs2.append, initial_lr=0.1, warmup_epochs=4)
+    for e in range(6):
+        warm.on_epoch_begin(e)
+    # true warm start at exactly initial_lr, ending at initial_lr * size
+    assert lrs2[0] == pytest.approx(0.1)
+    assert lrs2[-1] == pytest.approx(0.1 * hvd.num_slots())
+    assert lrs2[0] < lrs2[-1]
+
+
+def test_sparse_allreduce_rejects_unsupported_op(hvd8):
+    from jax.experimental import sparse as jsparse
+    b = jsparse.BCOO.fromdense(jnp.eye(2))
+    with pytest.raises(ValueError, match="SUM and AVERAGE"):
+        hvd.sparse_allreduce([b] * N, op=hvd.Min)
+
+
+def test_callback_list_dispatch(hvd8):
+    calls = []
+
+    class CB(hvd.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None, state=None):
+            calls.append(epoch)
+
+    cl = hvd.callbacks.CallbackList([CB(), CB()])
+    cl.on_epoch_end(3)
+    assert calls == [3, 3]
+
+
+# -- sparse ------------------------------------------------------------------
+
+def test_sparse_allreduce_emulated(hvd8):
+    from jax.experimental import sparse as jsparse
+    mats = []
+    dense_sum = np.zeros((4, 3), np.float32)
+    rng = np.random.RandomState(0)
+    for r in range(N):
+        d = np.zeros((4, 3), np.float32)
+        i, j = rng.randint(0, 4), rng.randint(0, 3)
+        d[i, j] = float(r + 1)
+        dense_sum += d
+        mats.append(jsparse.BCOO.fromdense(jnp.asarray(d)))
+    out = hvd.sparse_allreduce(mats, op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out.todense()), dense_sum,
+                               rtol=1e-6)
+    out_avg = hvd.sparse_allreduce(mats, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out_avg.todense()),
+                               dense_sum / N, rtol=1e-6)
+
+
+def test_densify_if_sparse(hvd8):
+    from jax.experimental import sparse as jsparse
+    d = jnp.asarray(np.eye(3, dtype=np.float32))
+    b = jsparse.BCOO.fromdense(d)
+    np.testing.assert_allclose(np.asarray(hvd.densify_if_sparse(b)), np.eye(3))
+    np.testing.assert_allclose(np.asarray(hvd.densify_if_sparse(d)), np.eye(3))
+
+
+# -- hierarchical allreduce ---------------------------------------------------
+
+@pytest.mark.parametrize("local_size", [2, 4])
+def test_hierarchical_allreduce_matches_flat(hvd8, local_size):
+    x = jnp.asarray(np.random.RandomState(1).randn(N, 5, 3)
+                    .astype(np.float32))
+    out = run_spmd(
+        hvd8, lambda t: C.hierarchical_allreduce(
+            t, C.Sum, local_size=local_size), x)
+    expected = np.sum(np.asarray(x), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expected, rtol=1e-5)
+
+
+def test_hierarchical_average_and_scales(hvd8):
+    x = jnp.asarray(np.random.RandomState(2).randn(N, 7).astype(np.float32))
+    out = run_spmd(
+        hvd8, lambda t: C.hierarchical_allreduce(
+            t, C.Average, local_size=4, prescale_factor=2.0), x)
+    expected = np.mean(2.0 * np.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-5)
+
+
+def test_hierarchical_invalid_local_size(hvd8):
+    x = jnp.ones((N, 4))
+    with pytest.raises(ValueError, match="divisible"):
+        run_spmd(hvd8, lambda t: C.hierarchical_allreduce(
+            t, C.Sum, local_size=3), x)
+
+
+def test_hierarchical_knob_via_public_api(hvd8, monkeypatch):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE is accepted and maps to the flat psum
+    (XLA's native torus decomposition) with identical numerics and the
+    invariant output type replicated out_specs require."""
+    st = hvd.core._state
+    monkeypatch.setattr(st.config, "hierarchical_allreduce", True)
+    monkeypatch.setattr(st.topology, "local_slots", 4)
+    x = jnp.asarray(np.random.RandomState(3).randn(N, 6).astype(np.float32))
+    out = run_spmd(hvd8, lambda t: hvd.allreduce(t, op=hvd.Sum), x)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.sum(np.asarray(x), 0), rtol=1e-5)
+    # replicated out_specs must hold (the psum result is axis-invariant)
+    from jax.sharding import PartitionSpec as P
+
+    def to_scalar(t):
+        return hvd.allreduce(jnp.sum(t), op=hvd.Average)
+
+    mesh = hvd8.mesh()
+    s = jax.jit(jax.shard_map(lambda t: to_scalar(t[0]), mesh=mesh,
+                              in_specs=P("hvd"), out_specs=P()))(x)
+    assert np.isfinite(float(s))
