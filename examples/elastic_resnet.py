@@ -1,0 +1,117 @@
+"""Elastic ResNet training under worker churn.
+
+BASELINE.json config 5: "Elastic ResNet-50 (examples/elastic, preemptible
+TPU-VM worker churn)".  Demonstrates the full elastic contract: TpuState
+commit/restore, the retry decorator, and checkpoint save/restore via the
+rank-0 convention.  Membership churn is driven by the elastic CLI
+(--host-discovery-script); this script is churn-agnostic — it just commits
+at safe points and keeps training.
+
+Run under the elastic launcher:
+    horovodrun --min-np 1 --max-np 8 --host-discovery-script ./discover.sh \
+        python examples/elastic_resnet.py
+Or standalone (emulated slice):
+    HVD_TPU_EMULATE_RANKS=8 python examples/elastic_resnet.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("HVD_TPU_EMULATE_RANKS"):
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import create_resnet50
+
+
+def main():
+    hvd.init()
+    nslots = hvd.num_slots()
+    model = create_resnet50(num_classes=10, dtype=jnp.float32, sync_bn=True)
+
+    def make_data():
+        # Batch is a function of the CURRENT world: rebuilt on every elastic
+        # resize (a fixed batch would stop dividing over the new mesh).
+        batch = 4 * hvd.num_slots()
+        images = jnp.asarray(np.random.RandomState(0)
+                             .rand(batch, 32, 32, 3).astype(np.float32))
+        labels = jnp.asarray(np.random.RandomState(1)
+                             .randint(0, 10, (batch,)))
+        return images, labels
+
+    images, labels = make_data()
+    variables = model.init(jax.random.PRNGKey(0), images[:1], train=False)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
+    state = hvd.elastic.TpuState(
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=opt.init(variables["params"]),
+        batch=0)
+
+    def local_step(params, batch_stats, opt_state, xb, yb):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, xb, train=True,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean(), mut["batch_stats"]
+        (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        u, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, u), nbs, opt_state,
+                hvd.allreduce(loss, op=hvd.Average))
+
+    def make_step():
+        # Rebuilt by the reset callback: the mesh (and compiled program)
+        # change when the world resizes.
+        return hvd.parallel.shard_step(
+            local_step,
+            in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+            out_specs=(P(), P(), P(), P()))
+
+    holder = {"step": make_step(), "data": (images, labels)}
+
+    def on_reset():
+        holder["step"] = make_step()
+        holder["data"] = make_data()
+
+    state.register_reset_callbacks([on_reset])
+
+    @hvd.elastic.run
+    def train(state):
+        loss = jnp.zeros(())  # defined even if re-entered with batch == 60
+        while state.batch < 60:
+            xb, yb = holder["data"]
+            state.params, state.batch_stats, state.opt_state, loss = \
+                holder["step"](state.params, state.batch_stats,
+                               state.opt_state, xb, yb)
+            state.batch += 1
+            if state.batch % 10 == 0:
+                state.commit()
+        return float(loss)
+
+    final = train(state)
+    # save() must be called from EVERY rank: rank 0 writes, the rest no-op
+    # into the completion barrier.
+    hvd.checkpoint.save("/tmp/elastic_resnet_ckpt",
+                        {"params": state.params, "batch": state.batch})
+    if hvd.rank() == 0:
+        print(f"elastic training finished: batches={state.batch} "
+              f"loss={final:.4f}")
+        print("checkpoint saved (rank-0 convention)")
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,7 @@ from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
 from .sparse import sparse_allreduce, densify_if_sparse  # noqa: F401
 
 from . import callbacks  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
 
 from . import parallel  # noqa: F401

@@ -1,0 +1,56 @@
+"""Checkpoint helpers: the rank-0-writes / broadcast-on-load convention.
+
+Reference behavior (SURVEY.md §5.4): Horovod standardizes (a)
+broadcast_variables / broadcast_object so rank 0's restored checkpoint
+reaches all ranks, (b) "only rank 0 writes to disk" in every example.  This
+module packages that convention over orbax (the JAX checkpointing library):
+``save`` writes from rank 0 only; ``restore`` loads on rank 0 and
+broadcasts, so a freshly-resized elastic world restores consistently.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from . import core as _core
+from . import functions as _functions
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: Any, force: bool = True) -> None:
+    """Write ``state`` (pytree) from rank 0 only; other ranks no-op and
+    wait at a barrier so nobody races ahead of an incomplete write."""
+    from . import ops as _ops
+    if _core.rank() == 0:
+        _ckptr().save(os.path.abspath(path), jax.device_get(state),
+                      force=force)
+    if _core.size() > 1 and not _core._require_init().topology.emulated:
+        _ops.barrier()
+
+
+def restore(path: str, template: Optional[Any] = None,
+            broadcast: bool = True) -> Any:
+    """Load on rank 0 and broadcast to every rank (broadcast_variables
+    pattern).  ``template`` provides the pytree structure/dtypes.  With a
+    shared filesystem every rank may read directly (broadcast=False)."""
+    topo = _core._require_init().topology
+    if topo.size == 1 or topo.emulated or not broadcast:
+        restored = _ckptr().restore(os.path.abspath(path), item=template)
+        return jax.tree_util.tree_map(jax.numpy.asarray, restored)
+    if _core.rank() == 0:
+        restored = _ckptr().restore(os.path.abspath(path), item=template)
+    else:
+        if template is None:
+            raise ValueError(
+                "restore with broadcast=True needs a template pytree on "
+                "non-root ranks (shapes/dtypes for the broadcast)")
+        restored = jax.tree_util.tree_map(jax.numpy.zeros_like, template)
+    restored = jax.tree_util.tree_map(jax.numpy.asarray, restored)
+    return _functions.broadcast_variables(restored, root_rank=0)

@@ -43,6 +43,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     axis_name: str = "hvd"
     seq_parallel: Optional[str] = None   # None | 'ring' | 'ulysses'
+    attention_impl: Optional[str] = None  # None (dense) | 'flash' (Pallas)
     remat: bool = False
 
 
@@ -74,12 +75,30 @@ class SelfAttention(nn.Module):
         qkv = dense(features=(3, cfg.num_heads, head_dim), axis=-1,
                     name="qkv")(x)
         q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, Dh]
+        if cfg.attention_impl not in (None, "flash"):
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}; "
+                f"expected None or 'flash'")
+        if cfg.attention_impl == "flash" and cfg.seq_parallel == "ring":
+            raise ValueError(
+                "attention_impl='flash' composes with seq_parallel=None or "
+                "'ulysses'; ring attention performs its own blockwise "
+                "online-softmax math and takes no local kernel")
+        local_attn = None
+        if cfg.attention_impl == "flash":
+            from ..parallel.flash import flash_attention
+
+            def local_attn(q, k, v, *, causal, scale=None):
+                return flash_attention(q, k, v, causal=causal, scale=scale)
         if cfg.seq_parallel == "ring":
             out = ring_attention(q, k, v, axis_name=cfg.axis_name,
                                  causal=cfg.causal)
         elif cfg.seq_parallel == "ulysses":
             out = ulysses_attention(q, k, v, axis_name=cfg.axis_name,
-                                    causal=cfg.causal)
+                                    causal=cfg.causal,
+                                    attention_fn=local_attn)
+        elif local_attn is not None:
+            out = local_attn(q, k, v, causal=cfg.causal)
         else:
             out = ring_attention_reference(q, k, v, causal=cfg.causal)
         return dense(features=cfg.d_model, axis=(-2, -1), name="proj")(out)

@@ -1,0 +1,134 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The local-attention compute inside sequence parallelism (the per-step block
+math of ring attention, or the full-sequence-per-head-subset attention of
+Ulysses) is the hot loop of long-context training.  This kernel keeps the
+whole online-softmax accumulation in VMEM — one [Bq, D] query block streams
+over K/V blocks with running (max, sum, acc) state, so the [S, S] score
+matrix never touches HBM and every matmul lands on the MXU with
+``preferred_element_type=float32``.
+
+Parity note: the reference has no attention kernels at all (it is a
+communication library); this is part of the TPU build's "beat the baseline"
+surface (SURVEY.md §5.8).  Numerics are validated against the dense
+reference implementation in tests (CPU interpret mode) and the kernel is
+exercised on the real chip by bench/examples.
+
+Layout: [B, S, H, D] public API; internally [B*H, S, D] with grid
+(batch*heads, q_blocks).  Block sizes default to 128 (MXU tile) and clamp
+to the sequence length.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+    num_kb = pl.cdiv(seq_len, block_k)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [Bq, Bk]
+        if causal:
+            qg = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kg = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qg >= kg, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    if causal:
+        # Only blocks with kb*block_k <= qi*block_q + block_q - 1 contribute;
+        # iterating past the diagonal would add fully-masked blocks (harmless
+        # numerically, wasted MXU cycles).
+        last = jnp.minimum(num_kb, (qi * block_q + block_q + block_k - 1)
+                           // block_k)
+    else:
+        last = num_kb
+    acc0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    *,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention over [B, S, H, D] (full local sequence).
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
+    same call works in the CPU-mesh test environment.  In interpret mode
+    under shard_map, pass ``check_vma=False`` to the shard_map (the
+    interpreter inlines the kernel, mixing invariant loop indices with
+    varying data); the compiled TPU path needs no such escape hatch."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"flash_attention requires seq len {S} divisible by block sizes "
+            f"({block_q}, {block_k})")
+
+    def reshape_in(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    qf, kf, vf = (reshape_in(x) for x in (q, k, v))
+    grid = (B * H, S // block_q)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, seq_len=S)
+    # Inside shard_map the output's varying-manual-axes must be declared;
+    # the attention output varies exactly as q does.
+    vma = getattr(jax.typeof(q), "vma", None)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct((B * H, S, D), q.dtype, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct((B * H, S, D), q.dtype)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,25 @@
+"""Checkpoint helpers: rank-0 save + broadcast restore (SURVEY.md §5.4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_save_restore_roundtrip(tmp_path, hvd8):
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.asarray(7)}
+    path = str(tmp_path / "ckpt")
+    hvd.checkpoint.save(path, state)
+    restored = hvd.checkpoint.restore(path, template=state)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert int(restored["step"]) == 7
+
+
+def test_restore_without_template_single(tmp_path, hvd8):
+    state = {"a": jnp.ones((3,))}
+    path = str(tmp_path / "ckpt2")
+    hvd.checkpoint.save(path, state)
+    restored = hvd.checkpoint.restore(path)
+    np.testing.assert_allclose(np.asarray(restored["a"]), np.ones(3))
