@@ -199,8 +199,9 @@ class MessageTable {
         return "Mismatched ops for collective " + name;
       }
       // Allreduce-family requires identical shapes; allgather-family
-      // (op >= 100 by convention) permits differing dim0.
-      bool allgather_like = sig.op >= 100;
+      // (op in [1000, 2000) by convention, see negotiation.py KIND_IDS)
+      // permits differing dim0.
+      bool allgather_like = sig.op >= 1000 && sig.op < 2000;
       if (allgather_like) {
         if (sig.shape.size() != ref.shape.size())
           return "Mismatched ranks (ndims) for allgather " + name;

@@ -130,7 +130,9 @@ def allreduce(tensor,
     out = eng.run("allreduce",
                   body, [tensor],
                   (int(rop), members, prescale_factor, postscale_factor),
-                  single, name=name, op_id=int(rop))[0]
+                  single, name=name, op_id=int(rop),
+                  prescale=prescale_factor, postscale=postscale_factor,
+                  ps_id=process_set.process_set_id or 0)[0]
     return compression.decompress(out, ctx)
 
 
@@ -189,7 +191,9 @@ def grouped_allreduce(tensors: Sequence,
 
         outs = eng.run("grouped_allreduce", body, list(ts),
                        (int(rop), members, prescale_factor, postscale_factor),
-                       single, name=name)
+                       single, name=name, op_id=int(rop),
+                       prescale=prescale_factor, postscale=postscale_factor,
+                       ps_id=process_set.process_set_id or 0)
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
 
 
@@ -239,7 +243,8 @@ def allgather(tensor, name: Optional[str] = None,
         return [ts[0]]
 
     return eng.run("allgather", body, [tensor], (members,), single,
-                   name=name)[0]
+                   name=name,
+                   ps_id=process_set.process_set_id or 0)[0]
 
 
 def _allgatherv_emulated(tensors: List, members) -> List:
@@ -331,7 +336,9 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
         return [ts[0]]
 
     return eng.run("broadcast", body, [tensor], (root_rank, members),
-                   single, name=name, stacked=stacked)[0]
+                   single, name=name, stacked=stacked,
+                   op_id=int(root_rank),
+                   ps_id=process_set.process_set_id or 0)[0]
 
 
 def broadcast_async(tensor, root_rank: int = 0, name=None,
@@ -372,7 +379,8 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
             return [ts[0]]
 
         return eng.run("alltoall", body, [tensor], (members,), single,
-                       name=name)[0]
+                       name=name,
+                       ps_id=process_set.process_set_id or 0)[0]
 
     if _axis_bound(axis):
         raise ValueError(
@@ -463,7 +471,9 @@ def reducescatter(tensor, op=ReduceOp.SUM, name: Optional[str] = None,
 
     return eng.run("reducescatter", body, [tensor],
                    (int(rop), members, prescale_factor, postscale_factor),
-                   single, name=name)[0]
+                   single, name=name, op_id=int(rop),
+                   prescale=prescale_factor, postscale=postscale_factor,
+                   ps_id=process_set.process_set_id or 0)[0]
 
 
 def reducescatter_async(tensor, op=ReduceOp.SUM, name=None,
@@ -523,15 +533,13 @@ def barrier(process_set: ProcessSet = global_process_set) -> None:
 
 def join(device: int = -1) -> int:
     """Signal this rank has no more data (hvd.join, torch/mpi_ops.py:1293;
-    JoinOp collective_operations.h:308); blocks until every rank joined and
-    returns the last rank to join.
+    JoinOp collective_operations.h:308): blocks until every rank joined,
+    contributing ZEROS to collectives the surviving ranks keep issuing
+    (uneven-data semantics), and returns the id of the last rank to join.
 
-    Under SPMD jit, uneven per-rank step counts cannot occur inside one
-    program, so eager join is a barrier + max-rank reduction.  The zeros
-    contribution for joined ranks in subsequent collectives is handled by the
-    elastic/eager negotiation layer."""
-    eng = _engine()
-    if eng.n == 1:
-        return 0
-    barrier()
-    return eng.n - 1
+    ``device`` is accepted for API parity (the reference pins the zero
+    buffers to a GPU; XLA manages placement here).  Under SPMD jit, uneven
+    per-rank step counts cannot occur inside one compiled program — join is
+    an eager/multi-controller feature."""
+    del device
+    return _engine().join()

@@ -181,7 +181,10 @@ class EagerEngine:
             static_params: Tuple, single_rank_fn,
             name: Optional[str] = None,
             stacked: Optional[bool] = None,
-            op_id: int = 0) -> List[jax.Array]:
+            op_id: int = 0,
+            prescale: float = 1.0,
+            postscale: float = 1.0,
+            ps_id: int = 0) -> List[jax.Array]:
         """Dispatch one eager collective; returns per-rank outputs
         (stacked in emulated mode, local otherwise).
 
@@ -255,7 +258,9 @@ class EagerEngine:
                             dims[0] = -1  # allgatherv: dim0 may differ
                         shape_sig.extend(dims)
                     neg.negotiate(label, kind, dtype_sig, tuple(shape_sig),
-                                  op_id, timeline=tl)
+                                  op_id, prescale=prescale,
+                                  postscale=postscale, ps_id=ps_id,
+                                  timeline=tl)
                 mesh = self._multiproc_mesh()
                 global_ts = [self._to_global(t) for t in tensors]
                 outs = self._stacked_run(kind, body, global_ts, static_params,
@@ -290,6 +295,108 @@ class EagerEngine:
             self._negotiator = Negotiator(self.topo.rank, self.topo.size,
                                           _core._state.config)
         return self._negotiator
+
+    # -- join (JoinOp, collective_operations.h:308) --------------------------
+
+    def join(self) -> int:
+        """Signal no-more-data; service peers' collectives with zero
+        contributions until every rank has joined; return the id of the last
+        rank to join (hvd.join semantics, torch/mpi_ops.py:1293)."""
+        import time as _time
+        if self.n == 1:
+            return 0
+        if self.topo.emulated or not self.negotiator.enabled:
+            # Single-controller emulation: all "ranks" share this process —
+            # everyone joins at once.
+            return self.n - 1
+        neg = self.negotiator
+        round_ = neg.join_round
+        neg.announce_join(round_)
+        seen = getattr(neg, "_joinop_seen", 0)
+        annc_seen: Dict[int, int] = getattr(neg, "_annc_seen", {})
+        deadline = _time.time() + neg._timeout
+        while True:
+            joined = neg.joined_ranks(round_)
+            if len(joined) == self.n:
+                break
+            seen, rec = neg.poll_joinop(seen)
+            if rec is not None:
+                self._dispatch_joinop(rec)
+                continue
+            if self.topo.rank == 0:
+                neg.service_announcements(annc_seen)
+            if _time.time() > deadline:
+                from ..exceptions import HorovodInternalError
+                raise HorovodInternalError(
+                    f"join timed out; joined={sorted(joined)} of {self.n}")
+            _time.sleep(0.01)
+        neg._joinop_seen = seen
+        neg._annc_seen = annc_seen
+        last = max(joined, key=lambda r: (joined[r], r))
+        neg.finish_join_round(round_, last)
+        neg.join_round += 1
+        return last
+
+    def _dispatch_joinop(self, rec: dict) -> None:
+        """Contribute zeros to a peer's collective (joined-ranks-contribute-
+        zeros, JoinOp semantics).  The signature encodes everything needed to
+        reconstruct the call (KIND_IDS folding, ops/negotiation.py)."""
+        from .. import core as _core
+        from .. import ops as _pub
+        sig, kind, name = rec["sig"], rec["kind"], rec["name"]
+        dtypes = sig["dtype"].split(",")
+        dims = sig["shape"]
+        shapes, i = [], 0
+        for _ in dtypes:
+            nd = dims[i]
+            i += 1
+            shapes.append(tuple(dims[i:i + nd]))
+            i += nd
+        if kind.startswith("allgather"):
+            # Ragged marker: joined ranks contribute an EMPTY slice (the
+            # allgatherv path pads/concats by announced sizes).
+            shapes = [tuple(0 if d < 0 else d for d in s) for s in shapes]
+        elif any(d < 0 for s in shapes for d in s):
+            get_logger().warning(
+                "join: cannot zero-fill collective %s; skipping", name)
+            return
+        # Stale record: this rank already participated in that epoch as a
+        # live rank before joining (e.g. a joinop published for a DIFFERENT
+        # rank's benefit) — replaying it would negotiate a finished epoch
+        # whose verdict may already be garbage-collected.
+        if rec["epoch"] < self.negotiator._epochs.get(name, 0):
+            return
+        zeros = [jnp.zeros(s, dtype=jnp.dtype(dt))
+                 for s, dt in zip(shapes, dtypes)]
+        # Align the local epoch counter with the negotiated epoch.
+        self.negotiator._epochs[name] = rec["epoch"]
+        op_id = sig["op"]
+        pre, post = sig.get("prescale", 1.0), sig.get("postscale", 1.0)
+        ps = _core._require_init().process_set_table.get(
+            sig.get("ps_id", 0))
+        if kind == "allreduce":
+            _pub.allreduce(zeros[0], op=_pub.ReduceOp(op_id), name=name,
+                           prescale_factor=pre, postscale_factor=post,
+                           process_set=ps)
+        elif kind == "grouped_allreduce":
+            _pub.grouped_allreduce(zeros, op=_pub.ReduceOp(op_id - 600),
+                                   name=name, prescale_factor=pre,
+                                   postscale_factor=post, process_set=ps)
+        elif kind == "broadcast":
+            _pub.broadcast(zeros[0], root_rank=op_id - 10000, name=name,
+                           process_set=ps)
+        elif kind == "reducescatter":
+            _pub.reducescatter(zeros[0], op=_pub.ReduceOp(op_id - 400),
+                               name=name, process_set=ps)
+        elif kind == "alltoall":
+            _pub.alltoall(zeros[0], name=name, process_set=ps)
+        elif kind == "barrier":
+            _pub.barrier()
+        elif kind in ("allgather", "allgather_sizes"):
+            _pub.allgather(zeros[0], name=name, process_set=ps)
+        else:
+            get_logger().warning("join: unsupported kind %s for %s; skipping",
+                                 kind, name)
 
     def claim_name(self, name: Optional[str]):
         if name is None:

@@ -37,17 +37,19 @@ from .. import config as _config
 from ..exceptions import HorovodInternalError, DuplicateNameError
 from ..utils import get_logger
 
-# Op-kind ids for cross-rank match checking; allgather-family ids are >= 100
+# Op-kind id bases; the per-op parameter (ReduceOp value, broadcast root)
+# is folded in so joined ranks can reconstruct the exact call from the
+# signature alone.  Ranges are disjoint; allgather-family ids are >= 1000
 # (the native Validate() relaxes dim0 matching for those).
 KIND_IDS = {
-    "allreduce": 0,        # + ReduceOp enum value is folded into params
-    "grouped_allreduce": 1,
-    "broadcast": 10,
-    "alltoall": 20,
-    "reducescatter": 30,
-    "barrier": 40,
-    "allgather": 100,
-    "allgather_sizes": 101,
+    "allreduce": 0,             # + ReduceOp (0..5)
+    "alltoall": 300,
+    "reducescatter": 400,       # + ReduceOp
+    "barrier": 500,
+    "grouped_allreduce": 600,   # + ReduceOp
+    "allgather": 1000,          # allgather-family: ids in [1000, 2000)
+    "allgather_sizes": 1001,
+    "broadcast": 10000,         # + root rank (unbounded above; own range)
 }
 
 
@@ -76,6 +78,10 @@ class Negotiator:
             cfg.stall_shutdown_time_seconds, size)
         self._epochs: Dict[str, int] = {}
         self._inval_seen = 0  # last observed cross-rank invalidation seq
+        self.join_round = 0
+        self._coordinating = set()     # (name, epoch) in a bg thread NOW
+        self._coordinated_done = set()  # (name, epoch) already coordinated
+        self._coord_lock = threading.Lock()
         self._timeout = float(os.environ.get(
             _config.HOROVOD_GLOO_TIMEOUT_SECONDS, "300"))
 
@@ -92,11 +98,14 @@ class Negotiator:
         traffic."""
         if not self.enabled:
             return
-        kind_id = KIND_IDS.get(kind, 0) + (op if kind == "allreduce" else 0)
+        kind_id = KIND_IDS.get(kind, 0) + op
         self._absorb_remote_invalidations()
         status = self.cache.lookup(name, dtype, shape, kind_id, prescale,
                                    postscale, ps_id)
-        if status == self._HIT:
+        if status == self._HIT and not self.join_active():
+            # Cache fast path — suspended while any rank is joined so the
+            # coordinator can keep publishing joinop records (the bitvector-
+            # sync analog, controller.cc:845).
             return
         if status == self._INVALID:
             # Shape/param change: renegotiate under a fresh epoch AND tell
@@ -117,8 +126,12 @@ class Negotiator:
         if timeline is not None:
             timeline.negotiate_start(name, kind.upper())
         self.client.put(scope, req_key, json.dumps(sig).encode())
+        self._maybe_announce(name, epoch, sig, kind)
         try:
-            if self.rank == 0:
+            with self._coord_lock:
+                bg_coordinated = ((name, epoch) in self._coordinating or
+                                  (name, epoch) in self._coordinated_done)
+            if self.rank == 0 and not bg_coordinated:
                 if epoch > 0:
                     # GC the previous epoch's verdict: everyone who needed it
                     # has moved on to this epoch (KV stays O(names x size)).
@@ -126,8 +139,9 @@ class Negotiator:
                         self.client.delete(scope, f"resp/{name}/{epoch - 1}")
                     except Exception:
                         pass
-                self._coordinate(name, epoch, sig, timeline)
-            verdict = self._wait_response(name, resp_key)
+                self._coordinate(name, epoch, sig, timeline, kind)
+            verdict = self._wait_response(name, resp_key,
+                                          reannounce=(epoch, sig, kind))
             # Own request record is consumed; drop it.
             try:
                 self.client.delete(scope, req_key)
@@ -165,18 +179,162 @@ class Negotiator:
                 setattr(self, f"_inval_seen_{r}", rec["seq"])
                 self.cache.invalidate(rec["name"])
 
+    # -- join protocol (JoinOp, collective_operations.h:308) -----------------
+    #
+    # A rank with no more data calls join(): it publishes a round-scoped
+    # join marker and enters a service loop (ops/eager.py EagerEngine.join).
+    # While any rank is joined, the cache fast path is suspended (every op
+    # negotiates — the analog of the reference's per-cycle bitvector sync
+    # keeping joined ranks in the loop).  When the coordinator sees that the
+    # only missing ranks are joined ones, it publishes a "joinop" record
+    # describing the pending collective; each joined rank's service loop
+    # dispatches the SAME collective with zero tensors (the reference's
+    # joined-ranks-contribute-zeros semantics), so SPMD execution stays
+    # total over all processes.  join() returns the id of the last rank to
+    # join, on every rank.
+
+    def join_active(self) -> bool:
+        now = time.time()
+        if now - getattr(self, "_join_check_ts", 0) < 0.05:
+            return getattr(self, "_join_check_val", False)
+        val = self.client.get("join", "active") is not None
+        self._join_check_ts = now
+        self._join_check_val = val
+        return val
+
+    def joined_ranks(self, round_: int) -> dict:
+        """rank -> join order timestamp for the given join round."""
+        out = {}
+        for r in range(self.size):
+            raw = self.client.get(f"join{round_}", str(r))
+            if raw is not None:
+                out[r] = json.loads(raw)["order"]
+        return out
+
+    def announce_join(self, round_: int) -> None:
+        self.client.put("join", "active", b"1")
+        self.client.put(f"join{round_}", str(self.rank),
+                        json.dumps({"order": time.time()}).encode())
+        self._join_check_val = True
+        self._join_check_ts = time.time()
+
+    def finish_join_round(self, round_: int, last_rank: int) -> None:
+        """The last-joining rank retires the round."""
+        if self.rank == last_rank:
+            try:
+                self.client.delete("join", "active")
+            except Exception:
+                pass
+        self._join_check_val = False
+        self._join_check_ts = 0.0
+        with self._coord_lock:
+            self._coordinated_done.clear()
+        if hasattr(self, "_announced"):
+            self._announced.clear()
+
+    def _maybe_announce(self, name: str, epoch: int, sig: dict,
+                        kind: str) -> None:
+        """If the coordinator (rank 0) has joined, the lowest-ranked survivor
+        announces the op so rank 0's service loop coordinates it.  Called at
+        submit time AND periodically while waiting for the verdict — rank 0
+        may join a moment after the first check (duplicate announcements are
+        deduped coordinator-side against the coordinated set)."""
+        if self.rank == 0 or not self.join_active():
+            return
+        joined = set(self.joined_ranks(self.join_round).keys())
+        if 0 not in joined:
+            return
+        survivors = [r for r in range(self.size) if r not in joined]
+        if not survivors or self.rank != min(survivors):
+            return
+        key = (name, epoch)
+        announced = getattr(self, "_announced", set())
+        self._announced = announced
+        if key in announced:
+            return
+        announced.add(key)
+        self._announce_for_coordinator(name, epoch, sig, kind)
+
+    def _announce_for_coordinator(self, name: str, epoch: int, sig: dict,
+                                  kind: str) -> None:
+        self._annc_seq = getattr(self, "_annc_seq", 0) + 1
+        self.client.put("annc", f"{self.rank}/{self._annc_seq}",
+                        json.dumps({"name": name, "epoch": epoch,
+                                    "sig": sig, "kind": kind}).encode())
+        self.client.put("annc", f"{self.rank}/seq",
+                        str(self._annc_seq).encode())
+
+    def service_announcements(self, seen: Dict[int, int]) -> None:
+        """Joined rank 0: coordinate ops announced by survivors.  Each new
+        announcement spawns a coordination thread (the op's verdict and
+        joinop record flow exactly as in the inline path); the (name, epoch)
+        is marked so rank 0's own zero-dispatch doesn't coordinate twice."""
+        for r in range(1, self.size):
+            raw = self.client.get("annc", f"{r}/seq")
+            if raw is None:
+                continue
+            latest = int(raw)
+            while seen.get(r, 0) < latest:
+                s = seen.get(r, 0) + 1
+                seen[r] = s
+                rec = json.loads(self.client.get("annc", f"{r}/{s}"))
+                key = (rec["name"], rec["epoch"])
+                with self._coord_lock:
+                    if key in self._coordinating or \
+                            key in self._coordinated_done:
+                        continue
+                    self._coordinating.add(key)
+
+                def coordinate(rec=rec, key=key):
+                    try:
+                        self._coordinate(rec["name"], rec["epoch"],
+                                         rec["sig"], None, rec["kind"])
+                    finally:
+                        with self._coord_lock:
+                            # Record completion BEFORE leaving the
+                            # in-flight set: rank 0's own zero-dispatch must
+                            # never re-coordinate a finished epoch.
+                            self._coordinated_done.add(key)
+                            self._coordinating.discard(key)
+
+                threading.Thread(target=coordinate, daemon=True,
+                                 name="hvd-join-coord").start()
+
+    def publish_joinop(self, name: str, epoch: int, sig: dict,
+                       kind: str) -> None:
+        self._joinop_seq = getattr(self, "_joinop_seq", 0) + 1
+        self.client.put("joinops", str(self._joinop_seq),
+                        json.dumps({"name": name, "epoch": epoch,
+                                    "sig": sig, "kind": kind}).encode())
+        self.client.put("joinops", "seq", str(self._joinop_seq).encode())
+
+    def poll_joinop(self, seen: int):
+        raw = self.client.get("joinops", "seq")
+        if raw is None:
+            return seen, None
+        seq = int(raw)
+        if seq <= seen:
+            return seen, None
+        rec = json.loads(self.client.get("joinops", str(seen + 1)))
+        return seen + 1, rec
+
     def _coordinate(self, name: str, epoch: int, my_sig: dict,
-                    timeline) -> None:
+                    timeline, kind: str = "allreduce") -> None:
         """Rank 0: gather all ranks' requests, run the native message table,
         publish the verdict (ComputeResponseList slow path).
 
         The message table is keyed per (name, epoch) and unconditionally
         erased on every exit path — an error verdict (timeout, duplicate,
-        stall shutdown) must not poison the name for the elastic retry."""
+        stall shutdown) must not poison the name for the elastic retry.
+
+        Join-awareness: when every missing rank has a join marker, publish a
+        joinop record so their service loops contribute zeros; their
+        requests then arrive like any other rank's."""
         tbl_key = f"{name}#{epoch}"
         deadline = time.time() + self._timeout
         arrived = set()
         last_stall_check = time.time()
+        joinop_published = False
         try:
             while len(arrived) < self.size:
                 for r in range(self.size):
@@ -200,6 +358,23 @@ class Negotiator:
                     if timeline is not None:
                         timeline.negotiate_rank_ready(name, r)
                 now = time.time()
+                if not joinop_published and len(arrived) < self.size and \
+                        self.join_active():
+                    missing = set(range(self.size)) - arrived
+                    joined = set(self.joined_ranks(
+                        getattr(self, "join_round", 0)).keys())
+                    if missing and missing <= joined:
+                        if kind == "broadcast" and \
+                                (my_sig["op"] - KIND_IDS["broadcast"]) in \
+                                joined:
+                            self._publish(
+                                name, epoch,
+                                f"broadcast root rank "
+                                f"{my_sig['op'] - KIND_IDS['broadcast']} has "
+                                f"joined (no data to broadcast)")
+                            return
+                        self.publish_joinop(name, epoch, my_sig, kind)
+                        joinop_published = True
                 if now - last_stall_check > 1.0:
                     last_stall_check = now
                     st, report = self.stall.check(now)
@@ -234,12 +409,19 @@ class Negotiator:
         self.client.put("negotiate", f"resp/{name}/{epoch}",
                         json.dumps({"error": err}).encode())
 
-    def _wait_response(self, name: str, resp_key: str) -> str:
+    def _wait_response(self, name: str, resp_key: str,
+                       reannounce=None) -> str:
         deadline = time.time() + self._timeout
+        last_announce_check = time.time()
         while time.time() < deadline:
             raw = self.client.get("negotiate", resp_key)
             if raw is not None:
                 return json.loads(raw).get("error", "")
+            now = time.time()
+            if reannounce is not None and now - last_announce_check > 0.5:
+                last_announce_check = now
+                epoch, sig, kind = reannounce
+                self._maybe_announce(name, epoch, sig, kind)
             time.sleep(0.005)
         raise HorovodInternalError(
             f"timed out waiting for negotiation verdict on {name!r}")

@@ -1,0 +1,115 @@
+"""Join semantics (JoinOp, collective_operations.h:308): uneven data across
+real processes — joined ranks contribute zeros until everyone joins."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import horovod_tpu as hvd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_RANK1_JOINS_EARLY = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+if hvd.rank() == 1:
+    last = hvd.join()     # no data: service peers with zeros
+    print(f"RANK1 joined, last={{last}}")
+else:
+    # rank 0 keeps training for 3 steps after rank 1 ran out of data
+    for step in range(3):
+        out = hvd.allreduce(jnp.full((4,), 2.0), op=hvd.Sum, name="g")
+        assert float(out[0]) == 2.0, f"step {{step}}: expected own value, got {{out}}"
+    b = hvd.barrier  # noqa - just reference
+    last = hvd.join()
+    print(f"RANK0 trained 3 steps solo, last={{last}}")
+assert last == 0  # rank 0 joined last
+"""
+
+WORKER_RANK0_JOINS_EARLY = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+if hvd.rank() == 0:
+    last = hvd.join()     # the coordinator itself runs out of data first
+    print(f"RANK0 joined, last={{last}}")
+else:
+    for step in range(2):
+        out = hvd.allreduce(jnp.full((3,), 5.0), op=hvd.Sum, name="h")
+        assert float(out[0]) == 5.0, f"step {{step}}: got {{out}}"
+    last = hvd.join()
+    print(f"RANK1 trained 2 steps solo, last={{last}}")
+assert last == 1  # rank 1 joined last
+"""
+
+
+def _run(script_text, tmp_path, name):
+    script = tmp_path / name
+    script.write_text(script_text.format(repo=REPO))
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+
+
+@pytest.mark.integration
+def test_join_rank1_early(tmp_path):
+    proc = _run(WORKER_RANK1_JOINS_EARLY, tmp_path, "j1.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RANK1 joined, last=0" in proc.stdout
+    assert "RANK0 trained 3 steps solo, last=0" in proc.stdout
+
+
+@pytest.mark.integration
+def test_join_coordinator_early(tmp_path):
+    """Rank 0 (the negotiation coordinator) joins first: its service loop
+    must keep coordinating the survivors' collectives via announcements."""
+    proc = _run(WORKER_RANK0_JOINS_EARLY, tmp_path, "j0.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RANK0 joined, last=1" in proc.stdout
+    assert "RANK1 trained 2 steps solo, last=1" in proc.stdout
+
+
+def test_join_emulated_trivial(hvd8):
+    assert hvd8.join() == 7
+
+
+WORKER_STAGGERED_3 = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+r = hvd.rank()
+steps = {{0: 3, 1: 1, 2: 2}}[r]
+for i in range(steps):
+    out = hvd.allreduce(jnp.ones((2,)), op=hvd.Sum, name="g")
+    alive = sum(1 for rr, s in {{0: 3, 1: 1, 2: 2}}.items() if s > i)
+    assert abs(float(out[0]) - alive) < 1e-6, (i, float(out[0]), alive)
+last = hvd.join()
+print(f"rank{{r}}: staggered ok last={{last}}")
+assert last == 0
+"""
+
+
+@pytest.mark.integration
+def test_join_staggered_three_ranks(tmp_path):
+    """Three ranks running out of data at different steps: each surviving
+    round sums exactly the live ranks (regression for the stale-joinop
+    replay deadlock)."""
+    script = tmp_path / "j3.py"
+    script.write_text(WORKER_STAGGERED_3.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "3",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(3):
+        assert f"rank{r}: staggered ok last=0" in proc.stdout

@@ -95,12 +95,12 @@ def test_msgtable_dtype_mismatch_names_ranks():
 
 def test_msgtable_allgather_ragged_dim0_allowed():
     mt = csrc.NativeMessageTable(2)
-    mt.increment("g", "float32", [4, 7], 100, rank=0)  # allgather kind
-    mt.increment("g", "float32", [9, 7], 100, rank=1)
+    mt.increment("g", "float32", [4, 7], 1000, rank=0)  # allgather kind
+    mt.increment("g", "float32", [9, 7], 1000, rank=1)
     assert mt.validate("g") == ""
     mt2 = csrc.NativeMessageTable(2)
-    mt2.increment("g", "float32", [4, 7], 100, rank=0)
-    mt2.increment("g", "float32", [9, 8], 100, rank=1)
+    mt2.increment("g", "float32", [4, 7], 1000, rank=0)
+    mt2.increment("g", "float32", [9, 8], 1000, rank=1)
     assert "trailing" in mt2.validate("g")
 
 
