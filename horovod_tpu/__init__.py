@@ -48,6 +48,7 @@ from .compression import Compression  # noqa: F401
 from .optimizer import (  # noqa: F401
     DistributedOptimizer, distributed_gradient_transformation,
     adasum_delta_step, value_and_grad, grad, local_value_and_grad,
+    PartialDistributedOptimizer,
 )
 
 from .functions import (  # noqa: F401

@@ -343,6 +343,42 @@ def DistributedOptimizer(optimizer,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def PartialDistributedOptimizer(optimizer,
+                                local_filter: Callable[[tuple, Any], bool],
+                                compression=Compression.none,
+                                op: ReduceOp = ReduceOp.AVERAGE,
+                                process_set: ProcessSet = global_process_set):
+    """DistributedOptimizer that leaves some parameters LOCAL (un-reduced).
+
+    Reference: PartialDistributedGradientTape / PartialDistributedOptimizer
+    (tensorflow/__init__.py:1204; keras PartialDistributedOptimizer) —
+    registered local variables (e.g. per-rank embeddings or adapters) skip
+    the allreduce while everything else synchronizes.
+
+    ``local_filter(path, leaf) -> True`` marks a gradient leaf as local.
+    ``path`` is the jax tree path (tuple of keys)."""
+    if optax is None:
+        raise ImportError("optax is required for the optimizer layer")
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(updates, state, params=None):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(updates)
+        reduced = []
+        for path, leaf in flat:
+            if local_filter(path, leaf):
+                reduced.append(leaf)
+            else:
+                reduced.append(_reduce_grad_leaf(
+                    leaf, op, compression, 1.0, 1.0, process_set))
+        synced = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(updates), reduced)
+        return optimizer.update(synced, state, params)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def local_value_and_grad(fun: Callable, **jax_kwargs):
     """``jax.value_and_grad`` that returns genuinely LOCAL (per-slot)
     gradients in-trace, pcasting replicated primals to varying so shard_map's

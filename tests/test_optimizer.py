@@ -222,3 +222,29 @@ def test_tape_local_grads_average_exactly(hvd8):
     out = run_spmd(hvd8, body, x)
     np.testing.assert_allclose(np.asarray(out[0]),
                                np.mean(np.asarray(x), 0), rtol=1e-5)
+
+
+def test_partial_distributed_optimizer(hvd8):
+    """Parameters matched by local_filter keep their LOCAL gradients
+    (PartialDistributedOptimizer, tensorflow/__init__.py:1204)."""
+    opt = hvd.PartialDistributedOptimizer(
+        optax.sgd(1.0),
+        local_filter=lambda path, leaf: "local" in str(path[0]))
+    params = {"shared": jnp.zeros((3,)), "local_emb": jnp.zeros((3,))}
+    g = jnp.asarray(np.random.RandomState(11).randn(N, 3).astype(np.float32))
+
+    def body(gr):
+        state = opt.init(params)
+        # make both grads VARYING per-slot values
+        updates, _ = opt.update({"shared": gr, "local_emb": gr}, state,
+                                params)
+        return updates["shared"], updates["local_emb"]
+
+    shared, local = run_spmd(hvd8, body, g)
+    arr = np.asarray(g)
+    # shared: averaged over ranks (same on all slots)
+    np.testing.assert_allclose(np.asarray(shared[0]), -arr.mean(0),
+                               rtol=1e-5)
+    # local: each slot keeps its own gradient
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(local[r]), -arr[r], rtol=1e-5)
