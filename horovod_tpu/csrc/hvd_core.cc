@@ -198,6 +198,16 @@ class MessageTable {
       if (sig.op != ref.op) {
         return "Mismatched ops for collective " + name;
       }
+      if (sig.process_set_id != ref.process_set_id) {
+        return "Mismatched process sets for collective " + name + ": rank " +
+               std::to_string(sigs.front().first) + " used set " +
+               std::to_string(ref.process_set_id) + ", rank " +
+               std::to_string(rank) + " used set " +
+               std::to_string(sig.process_set_id);
+      }
+      if (sig.prescale != ref.prescale || sig.postscale != ref.postscale) {
+        return "Mismatched prescale/postscale factors for collective " + name;
+      }
       // Allreduce-family requires identical shapes; allgather-family
       // (op in [1000, 2000) by convention, see negotiation.py KIND_IDS)
       // permits differing dim0.

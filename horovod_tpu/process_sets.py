@@ -35,8 +35,26 @@ class ProcessSet:
 
     process_set_id: Optional[int]
 
-    def __init__(self, ranks: Optional[Sequence[int]] = None):
+    def __init__(self, ranks: Optional[Sequence[int]] = None,
+                 mpi_comm=None):
+        """``ranks``: global slot ranks.  ``mpi_comm``: an mpi4py
+        communicator — its group's translated global ranks define the set
+        (reference: ProcessSet(mpi_comm), process_sets.py:18); requires
+        mpi4py at call time."""
         self.process_set_id = None
+        if mpi_comm is not None:
+            if ranks is not None:
+                raise ValueError("pass either ranks or mpi_comm, not both")
+            try:
+                from mpi4py import MPI
+            except ImportError as e:
+                raise ImportError(
+                    "ProcessSet(mpi_comm=...) requires mpi4py; on TPU pass "
+                    "the rank list instead") from e
+            group = mpi_comm.Get_group()
+            world = MPI.COMM_WORLD.Get_group()
+            ranks = MPI.Group.Translate_ranks(
+                group, list(range(group.Get_size())), world)
         self.ranks: Optional[List[int]] = (
             sorted(set(int(r) for r in ranks)) if ranks is not None else None)
 

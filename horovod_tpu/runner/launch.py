@@ -326,6 +326,7 @@ def _run_static(args) -> int:
     failure = threading.Event()
 
     def run_slot(i: int, slot: _hosts.SlotInfo):
+        out_fh = err_fh = None
         try:
             env = _worker_env(base_env, slot, addr, port, coordinator)
             prefix = f"[{slot.rank}]<stdout>:" if len(assignments) > 1 else ""
@@ -333,14 +334,27 @@ def _run_static(args) -> int:
                 cmd = args.command
             else:
                 cmd = _ssh_command(slot, args.command, env, args)
+            stdout = stderr = None
+            if args.output_filename:
+                # Per-rank output files (reference --output-filename: a
+                # directory with rank.N/stdout|stderr).
+                d = os.path.join(args.output_filename, f"rank.{slot.rank}")
+                os.makedirs(d, exist_ok=True)
+                out_fh = open(os.path.join(d, "stdout"), "w")
+                err_fh = open(os.path.join(d, "stderr"), "w")
+                stdout, stderr, prefix = out_fh, err_fh, ""
             rets[i] = safe_shell_exec.execute(
-                cmd, env=env, prefix=prefix,
+                cmd, env=env, prefix=prefix, stdout=stdout, stderr=stderr,
                 prefix_timestamp=args.prefix_output_with_timestamp,
                 events=[failure])
         except Exception as e:  # spawn failure must count as rank failure
             print(f"horovodrun: rank {slot.rank} failed to launch: {e}",
                   file=sys.stderr)
             rets[i] = 1
+        finally:
+            for fh in (out_fh, err_fh):
+                if fh is not None:
+                    fh.close()
         if rets[i] != 0:
             failure.set()
 

@@ -1,0 +1,96 @@
+"""In-process negotiation protocol tests: two Negotiator endpoints (threads)
+over a local KV store — fast coverage of the coordinator/worker contract
+without spawning worker processes."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.config import Config
+from horovod_tpu.exceptions import HorovodInternalError
+from horovod_tpu.ops.negotiation import Negotiator
+from horovod_tpu.runner.http_server import KVStoreServer
+
+
+@pytest.fixture()
+def kv_env(monkeypatch):
+    srv = KVStoreServer()
+    port = srv.start()
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", str(port))
+    monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "20")
+    yield srv
+    srv.stop()
+
+
+def _pair(kv_env):
+    cfg = Config.from_env()
+    return Negotiator(0, 2, cfg), Negotiator(1, 2, cfg)
+
+
+def _negotiate_both(n0, n1, sig0, sig1):
+    errs = [None, None]
+
+    def go(i, n, sig):
+        try:
+            n.negotiate(*sig)
+        except Exception as e:
+            errs[i] = e
+
+    t0 = threading.Thread(target=go, args=(0, n0, sig0))
+    t1 = threading.Thread(target=go, args=(1, n1, sig1))
+    t0.start(); t1.start()
+    t0.join(timeout=30); t1.join(timeout=30)
+    return errs
+
+
+def test_matching_signatures_pass(kv_env):
+    n0, n1 = _pair(kv_env)
+    errs = _negotiate_both(
+        n0, n1,
+        ("t", "allreduce", "float32", (4,), 1),
+        ("t", "allreduce", "float32", (4,), 1))
+    assert errs == [None, None]
+    # Second round: cache HIT on both sides (no traffic, returns instantly)
+    n0.negotiate("t", "allreduce", "float32", (4,), 1)
+    n1.negotiate("t", "allreduce", "float32", (4,), 1)
+
+
+def test_shape_mismatch_rejected_on_both(kv_env):
+    n0, n1 = _pair(kv_env)
+    errs = _negotiate_both(
+        n0, n1,
+        ("u", "allreduce", "float32", (4,), 1),
+        ("u", "allreduce", "float32", (5,), 1))
+    assert all(isinstance(e, HorovodInternalError) for e in errs)
+    assert "Mismatched shapes" in str(errs[0])
+
+
+def test_op_mismatch_rejected(kv_env):
+    n0, n1 = _pair(kv_env)
+    errs = _negotiate_both(
+        n0, n1,
+        ("v", "allreduce", "float32", (4,), 1),   # Sum
+        ("v", "allreduce", "float32", (4,), 0))   # Average
+    assert all(isinstance(e, HorovodInternalError) for e in errs)
+    assert "Mismatched ops" in str(errs[0])
+
+
+def test_shape_change_renegotiates_with_invalidation(kv_env):
+    n0, n1 = _pair(kv_env)
+    assert _negotiate_both(n0, n1, ("w", "allreduce", "float32", (4,), 1),
+                           ("w", "allreduce", "float32", (4,), 1)) == \
+        [None, None]
+    # Both change shape: INVALID -> fresh epoch -> succeeds again.
+    assert _negotiate_both(n0, n1, ("w", "allreduce", "float32", (8,), 1),
+                           ("w", "allreduce", "float32", (8,), 1)) == \
+        [None, None]
+
+
+def test_ps_id_mismatch_rejected(kv_env):
+    n0, n1 = _pair(kv_env)
+    errs = _negotiate_both(
+        n0, n1,
+        ("x", "allreduce", "float32", (4,), 1, 1.0, 1.0, 1),
+        ("x", "allreduce", "float32", (4,), 1, 1.0, 1.0, 2))
+    assert any(isinstance(e, HorovodInternalError) for e in errs)

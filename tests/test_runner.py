@@ -190,3 +190,25 @@ def test_static_run_failure_propagates(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
     assert "ranks failed" in proc.stderr
+
+
+@pytest.mark.integration
+def test_output_filename_redirection(tmp_path):
+    """--output-filename writes per-rank stdout files (reference
+    --output-filename directory convention)."""
+    outdir = tmp_path / "logs"
+    script = tmp_path / "w.py"
+    script.write_text("import os; print('hello from', os.environ['HOROVOD_RANK'])")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--output-filename", str(outdir), sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert (outdir / "rank.0" / "stdout").read_text().strip() == "hello from 0"
+    assert (outdir / "rank.1" / "stdout").read_text().strip() == "hello from 1"
+
+
+def test_process_set_mpi_comm_requires_mpi4py():
+    from horovod_tpu.process_sets import ProcessSet
+    with pytest.raises((ImportError, ValueError)):
+        ProcessSet(mpi_comm=object())
