@@ -66,6 +66,28 @@ class WorkerNotificationManager:
             return  # not an elastic run: no-op manager
         from ..runner.http_server import KVStoreClient
         client = KVStoreClient(addr, int(port))
+        # Baseline the discovery sequence: updates that predate this worker
+        # are already reflected in the world it was spawned into — replaying
+        # them would raise a spurious HostsUpdatedInterrupt and strand the
+        # worker waiting for a world version that never comes.  The driver
+        # stamps the spawn-time sequence into the env
+        # (HVD_TPU_DISCOVERY_SEQ), closing the spawn→init race; the KV read
+        # is the fallback for workers launched by other paths.
+        spawn_seq = os.environ.get("HVD_TPU_DISCOVERY_SEQ")
+        if spawn_seq is not None:
+            self._seen_version = int(spawn_seq)
+        else:
+            for attempt in range(3):
+                try:
+                    raw = client.get("discovery", "update")
+                    if raw:
+                        self._seen_version = json.loads(raw).get("version", 0)
+                    break
+                except Exception as e:
+                    get_logger().warning(
+                        "discovery baseline read failed (attempt %d): %s",
+                        attempt + 1, e)
+                    time.sleep(0.2)
 
         def poll():
             while not self._stop.is_set():
@@ -160,13 +182,30 @@ def _reset() -> None:
     _core.shutdown()
     if os.environ.get("HOROVOD_ELASTIC") == "1":
         _refresh_world_from_rendezvous()
+        import jax
         try:
-            import jax
             from jax._src import distributed as _jdist
             if getattr(_jdist.global_state, "client", None) is not None:
                 jax.distributed.shutdown()
         except Exception as e:
+            # A dead coordinator makes shutdown raise; the clear below still
+            # severs this process from the stale runtime.
             get_logger().warning("jax.distributed shutdown failed: %s", e)
+        try:
+            # A world-size change needs a fresh multi-process runtime: the
+            # backend was initialized for the OLD world, and
+            # jax.distributed.initialize refuses to run on a live backend.
+            # Dropping the backends forces re-initialization (and re-traces
+            # every compiled step — the recompilation cost SURVEY.md §7
+            # flags as inherent to elastic world changes).  Failure here
+            # must be FATAL: continuing would silently reuse the old world's
+            # runtime against the new world's env and hang collectives.
+            from jax._src import api as _jax_api
+            _jax_api.clear_backends()
+        except Exception as e:
+            raise HorovodInternalError(
+                f"failed to reset the JAX backend for the new world: {e}"
+            ) from e
     _core.init()
 
 
@@ -197,6 +236,14 @@ def run(func):
             while True:
                 if reset_required:
                     _reset()
+                    # Restore AFTER the backend reset: the in-memory commit
+                    # holds host (numpy) copies, so restore re-materializes
+                    # arrays on the NEW backend.  (Restoring before the
+                    # reset would leave State attributes pointing at deleted
+                    # buffers of the old backend.)  On the interrupt path
+                    # this equals the current values: commit() saved
+                    # immediately before raising.
+                    state.restore()
                     state.on_reset()
                 try:
                     if not skip_sync:
@@ -205,7 +252,6 @@ def run(func):
                 except HorovodInternalError:
                     get_logger().info(
                         "elastic: collective failure — restoring last commit")
-                    state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:
                     get_logger().info(

@@ -39,6 +39,10 @@ def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
             _config.HOROVOD_RENDEZVOUS_PORT: str(port),
             "HOROVOD_ELASTIC": "1",
             "HVD_TPU_WORLD_VERSION": str(world_version),
+            # Spawn-time discovery sequence: the notification manager
+            # baselines here so pre-spawn updates are not replayed and
+            # post-spawn ones are never missed.
+            "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
             "HVD_TPU_COORDINATOR":
                 f"{addr}:{int(os.environ.get('HVD_TPU_COORD_PORT', 29400))}",
         })

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import horovod_tpu as hvd
 from horovod_tpu import elastic as E
 from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
@@ -381,3 +383,72 @@ def test_notification_seq_monotonic():
     finally:
         driver.stop()
         rdv.stop()
+
+
+ELASTIC_SCALEUP_WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys, os; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+# TpuState: carries a LIVE jax array through the backend reset (it must be
+# re-materialized from the host commit on the new backend).
+state = hvd.elastic.TpuState(params={{"w": jnp.full((2,), 3.0)}},
+                             batch=0, sizes=[])
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 15:
+        out = hvd.allreduce(jnp.ones((2,)), op=hvd.Sum, name="g")
+        state.sizes.append(int(float(out[0])))
+        state.params = {{"w": state.params["w"] + 1.0}}
+        state.batch += 1
+        state.commit()
+        import time; time.sleep(0.8)
+    return state.sizes
+
+sizes = train(state)
+w = float(state.params["w"][0])
+print(f"WORKER done rank={{hvd.rank()}} final_size={{hvd.size()}} "
+      f"w={{w}} sizes={{sizes}}", flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_elastic_scale_up_end_to_end(tmp_path):
+    """A REAL scale-up: training starts at world size 1, discovery adds a
+    host mid-run, the survivor re-rendezvouses, the new worker receives
+    synced state, and both finish at size 2 (the full
+    HostsUpdatedInterrupt → reset → jax.distributed re-init cycle)."""
+    import subprocess
+    import sys
+    hosts_file = tmp_path / "hosts_now.txt"
+    hosts_file.write_text("localhost:1\n")
+    disc = tmp_path / "disc.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disc.chmod(0o755)
+    worker = tmp_path / "worker.py"
+    worker.write_text(ELASTIC_SCALEUP_WORKER.format(repo=REPO))
+
+    def scale_up():
+        time.sleep(8)
+        hosts_file.write_text("localhost:2\n")
+
+    t = threading.Thread(target=scale_up, daemon=True)
+    t.start()
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(worker)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "WORKER done rank=0 final_size=2" in proc.stdout
+    assert "WORKER done rank=1 final_size=2" in proc.stdout
+    # The allreduce sums must show the world growing: some 1s then 2s.
+    import re as _re
+    m = _re.search(r"rank=0 final_size=2 w=18.0 sizes=\[([0-9, ]+)\]",
+                   proc.stdout)
+    assert m, proc.stdout[-2000:]
+    sizes = [int(x) for x in m.group(1).split(",")]
+    assert 1 in sizes and 2 in sizes and sizes == sorted(sizes)
