@@ -37,7 +37,29 @@ ITERS = 30
 BASELINE_IMG_S_PER_DEV = 1656.82 / 16  # docs/benchmarks.rst:40-42
 
 
+def bench_bert():
+    """BENCH_MODEL=bert-large: BERT-large MLM samples/sec (BASELINE config 3).
+    Keeps the same one-JSON-line contract; the reference publishes no BERT
+    number, so vs_baseline reports per-chip samples/sec directly."""
+    import contextlib
+    from examples.bert_pretraining import main as bert_main
+    with contextlib.redirect_stdout(sys.stderr):  # keep stdout = 1 JSON line
+        losses, samples_s = bert_main(["--size", "large", "--steps", "10",
+                                       "--batch-per-slot", "8",
+                                       "--seq-len", "128"])
+    print(json.dumps({
+        "metric": "bert_large_mlm_samples_per_sec",
+        "value": round(samples_s, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_s / hvd.num_slots(), 3),
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODEL", "").startswith("bert"):
+        hvd.init()
+        bench_bert()
+        return
     hvd.init()
     nslots = hvd.num_slots()
     model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16, sync_bn=True)

@@ -166,8 +166,17 @@ class Negotiator:
 
     def _absorb_remote_invalidations(self) -> None:
         """Before trusting a cache HIT, absorb other ranks' invalidation
-        markers (one KV GET per peer per dispatch — the eager path trades a
-        millisecond for coherence; the compiled path never pays this)."""
+        markers.  The peer scan is O(size) KV GETs, so it runs at most every
+        50 ms (the reference amortizes the same coherence into one bitvector
+        collective per 1 ms cycle).  Shape changes are rare; in the worst
+        case a stale HIT inside the 50 ms window dispatches into a collective
+        the renegotiating rank never joins, and that rank's negotiation
+        times out with a named error — degraded diagnosis, never silent
+        corruption."""
+        now = time.time()
+        if now - getattr(self, "_inval_check_ts", 0.0) < 0.05:
+            return
+        self._inval_check_ts = now
         for r in range(self.size):
             if r == self.rank:
                 continue

@@ -66,46 +66,49 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q32 = q.astype(jnp.float32)
     neg_inf = jnp.float32(-1e30)
 
-    # Online-softmax state.
-    acc = jnp.zeros((B, H, Sq, D), jnp.float32)
-    m = jnp.full((B, H, Sq, 1), neg_inf)
-    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    # Online-softmax state, derived from q32 so the carry's varying-manual-
+    # axes type matches the scan body's outputs (fresh constants would be
+    # axis-invariant and lax.scan requires carry-type equality).
+    acc = jnp.einsum("bqhd->bhqd", q32) * 0.0          # [B, H, Sq, D]
+    m = jnp.max(acc, axis=-1, keepdims=True) * 0.0 + neg_inf
+    l = jnp.zeros_like(m)
 
     # Rotate K/V around the ring: after step t, we hold the block that
     # originated on rank (my + t) % n.  ppermute source->dest pairs send
     # each shard to its left neighbor (dest = src - 1 mod n), so hop t
-    # brings in blocks from increasing ring distance.
+    # brings in blocks from increasing ring distance.  The rotation runs
+    # under lax.scan so the compiled program is O(1) in ring size — a
+    # 256-chip ring must not unroll 256 attention blocks into the HLO.
     perm = [(i, (i - 1) % n) for i in range(n)]
-
-    kv_k = k.astype(jnp.float32)
-    kv_v = v.astype(jnp.float32)
 
     if causal:
         iota_q = lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0)
         iota_k = lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1)
         tri_mask = iota_q >= iota_k  # within-block causal (equal block sizes)
 
-    for step in range(n):
+    def round_fn(carry, step):
+        kv_k, kv_v, acc, m, l = carry
         owner = (my + step) % n  # global position of the current K/V block
         s = _block_scores(q32, kv_k, scale)  # [B, H, Sq, Sk]
         if causal:
             # Block-level mask: owner < my -> full attend; owner == my ->
             # triangular; owner > my -> fully masked.
-            full = (owner < my)
-            diag = (owner == my)
             block_mask = jnp.where(
-                diag, tri_mask,
-                jnp.broadcast_to(full, tri_mask.shape))
+                owner == my, tri_mask,
+                jnp.broadcast_to(owner < my, tri_mask.shape))
             s = jnp.where(block_mask[None, None], s, neg_inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, kv_v)
-        m = m_new
-        if step != n - 1:
-            kv_k = lax.ppermute(kv_k, axis_name, perm)
-            kv_v = lax.ppermute(kv_v, axis_name, perm)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, kv_v)
+        kv_k = lax.ppermute(kv_k, axis_name, perm)
+        kv_v = lax.ppermute(kv_v, axis_name, perm)
+        return (kv_k, kv_v, acc_new, m_new, l_new), None
+
+    init = (k.astype(jnp.float32), v.astype(jnp.float32), acc, m, l)
+    (kv_k, kv_v, acc, m, l), _ = lax.scan(
+        round_fn, init, jnp.arange(n, dtype=jnp.int32))
 
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

@@ -212,3 +212,32 @@ def test_process_set_mpi_comm_requires_mpi4py():
     from horovod_tpu.process_sets import ProcessSet
     with pytest.raises((ImportError, ValueError)):
         ProcessSet(mpi_comm=object())
+
+
+PS_WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+ps = hvd.add_process_set([0])
+out = hvd.allreduce(jnp.full((2,), float(hvd.rank() + 1)), op=hvd.Sum,
+                    name="sub", process_set=ps)
+expect = 1.0 if hvd.rank() == 0 else 2.0
+assert abs(float(out[0]) - expect) < 1e-6, (hvd.rank(), out)
+print(f"rank{{hvd.rank()}} PS OK")
+"""
+
+
+@pytest.mark.integration
+def test_process_set_subset_across_processes(tmp_path):
+    """Eager subset collective across real processes: member reduces over
+    the set, non-member keeps its input (mask lowering end-to-end)."""
+    script = tmp_path / "ps.py"
+    script.write_text(PS_WORKER.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rank0 PS OK" in proc.stdout and "rank1 PS OK" in proc.stdout
