@@ -193,6 +193,10 @@ class EagerEngine:
         (common.h:239), and named ops get timeline lifecycle events."""
         from .. import core as _core
         tl = _core._state.timeline
+        if tl is not None:
+            # Each eager dispatch is one "cycle" of the runtime
+            # (HOROVOD_TIMELINE_MARK_CYCLES, timeline.cc MarkCycle).
+            tl.mark_cycle()
         # Unnamed ops get a stable signature-derived label: distinct unnamed
         # collectives must not share one negotiation/cache key (they would
         # alternately invalidate each other), and per-call counters would
