@@ -35,7 +35,9 @@ class ParameterManager:
                  samples_per_candidate: int = 5,
                  initial_threshold: int = 128 * 1024 * 1024,
                  log_path: Optional[str] = None,
-                 decide_fn=None):
+                 decide_fn=None,
+                 search: str = "sweep",
+                 bayes_rounds: int = 12):
         """``decide_fn(local_best_threshold) -> final_threshold``: the
         SynchronizeParameters hook (parameter_manager.h) — in
         multi-controller mode, rank 0's choice is published through the
@@ -45,6 +47,7 @@ class ParameterManager:
         itself is deterministic: the candidate schedule advances on sample
         COUNT, identical on all ranks."""
         self.enabled = enabled
+        self.search = search  # 'sweep' | 'bayes' (GP + expected improvement)
         self.candidates = [int(mb) * 1024 * 1024 for mb in candidates_mb]
         self.samples_per_candidate = samples_per_candidate
         self._scores: List[List[float]] = [[] for _ in self.candidates]
@@ -55,11 +58,22 @@ class ParameterManager:
         self._log = open(log_path, "a") if log_path else None
         if self._log:
             self._log.write("candidate_bytes,score_bytes_per_sec\n")
+        if search == "bayes" and enabled:
+            # Knob space: log2(bytes) in [20, 28] = 1 MB .. 256 MB, the same
+            # span as the sweep candidates (bayesian_optimization.cc model).
+            from .optim import BayesianOptimizer
+            self._bo = BayesianOptimizer(low=20.0, high=28.0)
+            self._bo_rounds = bayes_rounds
+            self._bo_round = 0
+            self._bo_current = self._bo.suggest()
+            self._bo_scores: List[float] = []
 
     @property
     def fusion_threshold_bytes(self) -> int:
         if self._converged:
             return self._threshold
+        if self.search == "bayes":
+            return int(2 ** self._bo_current)
         return self.candidates[self._idx]
 
     @property
@@ -72,6 +86,29 @@ class ParameterManager:
         if self._converged or seconds <= 0:
             return
         score = nbytes / seconds
+        if self.search == "bayes":
+            self._bo_scores.append(score)
+            if self._log:
+                self._log.write(
+                    f"{int(2 ** self._bo_current)},{score:.1f}\n")
+                self._log.flush()
+            if len(self._bo_scores) >= self.samples_per_candidate:
+                self._bo.observe(self._bo_current,
+                                 sum(self._bo_scores) / len(self._bo_scores))
+                self._bo_scores = []
+                self._bo_round += 1
+                if self._bo_round >= self._bo_rounds:
+                    local = int(2 ** self._bo.best())
+                    self._threshold = (self._decide_fn(local)
+                                       if self._decide_fn else local)
+                    self._converged = True
+                    if self._log:
+                        self._log.write(
+                            f"# converged threshold={self._threshold}\n")
+                        self._log.flush()
+                else:
+                    self._bo_current = self._bo.suggest()
+            return
         self._scores[self._idx].append(score)
         if self._log:
             self._log.write(f"{self.candidates[self._idx]},{score:.1f}\n")

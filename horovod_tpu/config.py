@@ -57,6 +57,9 @@ HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 # TPU-build specific knobs (new; no reference analog).
 HVD_TPU_EMULATE_RANKS = "HVD_TPU_EMULATE_RANKS"  # treat N local devices as N ranks
 HVD_TPU_MESH_AXIS = "HVD_TPU_MESH_AXIS"          # mesh axis name, default "hvd"
+HVD_TPU_COMPILATION_CACHE = "HVD_TPU_COMPILATION_CACHE"  # persistent XLA cache dir
+HOROVOD_AUTOTUNE_SEARCH = "HOROVOD_AUTOTUNE_SEARCH"      # 'sweep' | 'bayes'
+HOROVOD_AUTOTUNE_BAYES_ROUNDS = "HOROVOD_AUTOTUNE_BAYES_ROUNDS"
 
 
 def env_bool(name: str, default: bool = False) -> bool:
@@ -102,6 +105,8 @@ class Config:
     # Autotune (parameter_manager.h:42-110).
     autotune: bool = False
     autotune_log: Optional[str] = None
+    autotune_search: str = "sweep"   # 'bayes' = GP + expected improvement
+    autotune_bayes_rounds: int = 12
     # Timeline (timeline.h:48,108).
     timeline_path: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -120,6 +125,7 @@ class Config:
     # TPU-specific.
     emulate_ranks: int = 0
     mesh_axis: str = "hvd"
+    compilation_cache_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -133,6 +139,8 @@ class Config:
             torus_allreduce=env_bool(HOROVOD_TORUS_ALLREDUCE),
             autotune=env_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG),
+            autotune_search=os.environ.get(HOROVOD_AUTOTUNE_SEARCH, "sweep"),
+            autotune_bayes_rounds=env_int(HOROVOD_AUTOTUNE_BAYES_ROUNDS, 12),
             timeline_path=os.environ.get(HOROVOD_TIMELINE),
             timeline_mark_cycles=env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             stall_check_enabled=not env_bool(HOROVOD_STALL_CHECK_DISABLE),
@@ -147,4 +155,5 @@ class Config:
             log_hide_timestamp=env_bool(HOROVOD_LOG_HIDE_TIME),
             emulate_ranks=env_int(HVD_TPU_EMULATE_RANKS, 0),
             mesh_axis=os.environ.get(HVD_TPU_MESH_AXIS, "hvd"),
+            compilation_cache_dir=os.environ.get(HVD_TPU_COMPILATION_CACHE),
         )

@@ -104,6 +104,18 @@ def init(comm: Optional[Sequence[int]] = None,
         if _state.initialized:
             return
         cfg = _config.Config.from_env()
+        if cfg.compilation_cache_dir:
+            # Persistent XLA compilation cache: elastic world resizes and
+            # relaunches re-trace every program (SURVEY.md §7 "hide latency
+            # with compilation cache") — this makes the re-compile a disk hit.
+            import jax
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  cfg.compilation_cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5)
+            except Exception as e:
+                get_logger().warning("compilation cache setup failed: %s", e)
         _maybe_join_distributed(cfg)
         topo = _topology.detect(cfg)
         if comm is not None and list(comm) != list(range(topo.size)):
@@ -144,11 +156,25 @@ def init(comm: Optional[Sequence[int]] = None,
                 _time.sleep(0.05)
             return local_choice
 
+        search = cfg.autotune_search
+        if cfg.autotune and search == "bayes" and topo.size > 1 and \
+                not topo.emulated:
+            # BO's schedule depends on rank-local scores: divergent
+            # candidates during exploration would desynchronize fusion
+            # buckets across ranks.  The deterministic sweep explores
+            # identically everywhere; BO serves the single-controller case
+            # (one process driving the whole slice — the common SPMD mode).
+            get_logger().warning(
+                "HOROVOD_AUTOTUNE_SEARCH=bayes requires single-controller "
+                "mode; falling back to the deterministic sweep")
+            search = "sweep"
         _state.param_manager = ParameterManager(
             enabled=cfg.autotune,
             initial_threshold=cfg.fusion_threshold_bytes,
             log_path=cfg.autotune_log if topo.rank == 0 else None,
-            decide_fn=_synced_decision)
+            decide_fn=_synced_decision,
+            search=search,
+            bayes_rounds=cfg.autotune_bayes_rounds)
         if cfg.timeline_path and topo.rank == 0:
             # Rank 0 writes the trace, like the reference coordinator
             # (HOROVOD_TIMELINE, operations.cc:1077).

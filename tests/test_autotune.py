@@ -49,3 +49,63 @@ def test_eager_gradient_fusion_buckets(hvd8):
     for k, g in grads.items():
         np.testing.assert_allclose(
             np.asarray(updates[k][0]), -np.asarray(g)[0], rtol=1e-5)
+
+
+# -- Gaussian-process Bayesian search (optim/bayesian_optimization.cc) -------
+
+def test_gp_fits_and_predicts():
+    from horovod_tpu.optim import GaussianProcess
+    x = np.linspace(0, 1, 9)[:, None]
+    y = np.sin(3 * x[:, 0])
+    gp = GaussianProcess(length_scale=0.3)
+    gp.fit(x, y)
+    mean, std = gp.predict(x)
+    np.testing.assert_allclose(mean, y, atol=0.05)   # interpolates samples
+    assert np.all(std[1:-1] < 0.2)
+    mean_far, std_far = gp.predict(np.array([[0.5 + 1.5]]))
+    assert std_far[0] > std[4]  # extrapolation is less certain
+
+
+def test_expected_improvement_prefers_unexplored():
+    from horovod_tpu.optim import expected_improvement
+    mean = np.array([1.0, 1.0])
+    std = np.array([0.0, 0.5])
+    ei = expected_improvement(mean, std, best=1.0)
+    assert ei[1] > ei[0] == 0.0
+
+
+def test_bayesian_optimizer_finds_peak():
+    from horovod_tpu.optim import BayesianOptimizer
+
+    def objective(x):  # peak at 24.5 in [20, 28]
+        return -((x - 24.5) ** 2)
+
+    bo = BayesianOptimizer(20, 28)
+    for _ in range(14):
+        x = bo.suggest()
+        bo.observe(x, objective(x))
+    assert abs(bo.best() - 24.5) < 0.8
+
+
+def test_bayes_schedule_deterministic():
+    from horovod_tpu.optim import BayesianOptimizer
+    a, b = BayesianOptimizer(20, 28), BayesianOptimizer(20, 28)
+    for _ in range(8):
+        xa, xb = a.suggest(), b.suggest()
+        assert xa == xb  # identical histories -> identical suggestions
+        a.observe(xa, -(xa - 25) ** 2)
+        b.observe(xb, -(xb - 25) ** 2)
+
+
+def test_parameter_manager_bayes_mode_converges(tmp_path):
+    pm = ParameterManager(enabled=True, samples_per_candidate=1,
+                          search="bayes", bayes_rounds=10,
+                          log_path=str(tmp_path / "bo.csv"))
+    # Score model: throughput peaks at 8 MB (2^23 bytes).
+    for _ in range(10):
+        thr = pm.fusion_threshold_bytes
+        score = -abs(np.log2(thr) - 23.0) + 10.0
+        pm.record_sample(nbytes=int(score * 1e6), seconds=1.0)
+    assert pm.converged
+    assert 21.0 <= np.log2(pm.fusion_threshold_bytes) <= 25.0
+    assert "converged threshold=" in (tmp_path / "bo.csv").read_text()
