@@ -1,3 +1,6 @@
 from .data_loader_base import (  # noqa: F401
     BaseDataLoader, AsyncDataLoaderMixin, AsyncDataLoader,
     ShardedDataLoader)
+
+from .service import (  # noqa: F401
+    DataServiceWorker, RemoteDataset, serve_dataset)

@@ -1,0 +1,145 @@
+"""Data service: host processes serve preprocessed batches to trainers.
+
+Reference: the Horovod-managed ``tf.data.experimental.service`` cluster
+(runner/common/service/compute_service.py:99 ComputeService — an RPC
+registry of dispatchers and workers — plus tensorflow/data/
+compute_service.py's send/read sides).  SURVEY.md §7 marks a TPU analog
+optional; this is the minimal honest version: dedicated CPU-heavy hosts run
+``serve_dataset`` (a batch producer + HTTP endpoint registered in the
+rendezvous KV store), and each trainer iterates ``RemoteDataset`` which
+round-robins pickled batches from the registered producers — decoupling
+input preprocessing from accelerator hosts the way the reference's data
+service does.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Iterable, Iterator, List, Optional
+
+from ..runner.http_server import KVStoreClient
+
+REGISTRY_SCOPE = "dataservice"
+
+
+class _BatchHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if self.path != "/next":
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            payload = self.server.batch_queue.get(timeout=30)
+        except queue.Empty:
+            self.send_response(204)  # drained / producer finished
+            self.end_headers()
+            return
+        if payload is None:
+            self.server.exhausted = True
+            self.send_response(410)  # Gone: dataset exhausted
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class DataServiceWorker:
+    """One producer endpoint (the reference's data-service *worker*): pulls
+    batches from an iterable on a background thread, serves them over HTTP,
+    registers itself in the rendezvous KV store."""
+
+    def __init__(self, dataset: Iterable[Any], worker_id: int = 0,
+                 rendezvous_addr: Optional[str] = None,
+                 rendezvous_port: Optional[int] = None,
+                 queue_size: int = 8):
+        self.dataset = dataset
+        self.worker_id = worker_id
+        self._rdv = (rendezvous_addr, rendezvous_port)
+        self._queue_size = queue_size
+        self.httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> int:
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", 0), _BatchHandler)
+        self.httpd.batch_queue = queue.Queue(maxsize=self._queue_size)
+        self.httpd.exhausted = False
+        port = self.httpd.server_address[1]
+
+        def produce():
+            for item in self.dataset:
+                self.httpd.batch_queue.put(pickle.dumps(item))
+            self.httpd.batch_queue.put(None)
+
+        threading.Thread(target=produce, daemon=True,
+                         name="hvd-data-producer").start()
+        threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                         name="hvd-data-server").start()
+        addr, rport = self._rdv
+        if addr and rport:
+            import socket
+            my = socket.gethostbyname(socket.gethostname())
+            KVStoreClient(addr, int(rport)).put(
+                REGISTRY_SCOPE, f"worker/{self.worker_id}",
+                f"{my}:{port}".encode())
+        return port
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+
+
+def serve_dataset(dataset: Iterable[Any], worker_id: int = 0,
+                  rendezvous_addr: Optional[str] = None,
+                  rendezvous_port: Optional[int] = None) -> DataServiceWorker:
+    """Start serving ``dataset`` (compute_worker_fn analog)."""
+    w = DataServiceWorker(dataset, worker_id, rendezvous_addr,
+                          rendezvous_port)
+    w.start()
+    return w
+
+
+class RemoteDataset:
+    """Trainer-side iterator (send_to_data_service read side): round-robins
+    /next across endpoints until every producer reports exhaustion."""
+
+    def __init__(self, endpoints: Optional[List[str]] = None,
+                 rendezvous_addr: Optional[str] = None,
+                 rendezvous_port: Optional[int] = None,
+                 num_workers: int = 1):
+        if endpoints is None:
+            if not (rendezvous_addr and rendezvous_port):
+                raise ValueError("pass endpoints or a rendezvous address")
+            client = KVStoreClient(rendezvous_addr, int(rendezvous_port))
+            endpoints = []
+            for w in range(num_workers):
+                raw = client.get(REGISTRY_SCOPE, f"worker/{w}")
+                if raw:
+                    endpoints.append(raw.decode())
+        if not endpoints:
+            raise ValueError("no data-service endpoints registered")
+        self.endpoints = endpoints
+
+    def __iter__(self) -> Iterator[Any]:
+        import urllib.error
+        import urllib.request
+        live = list(self.endpoints)
+        while live:
+            for ep in list(live):
+                try:
+                    resp = urllib.request.urlopen(f"http://{ep}/next",
+                                                  timeout=60)
+                    yield pickle.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    if e.code in (410, 204):
+                        live.remove(ep)
+                    else:
+                        raise

@@ -201,3 +201,37 @@ def test_hierarchical_knob_via_public_api(hvd8, monkeypatch):
     s = jax.jit(jax.shard_map(lambda t: to_scalar(t[0]), mesh=mesh,
                               in_specs=P("hvd"), out_specs=P()))(x)
     assert np.isfinite(float(s))
+
+
+# -- data service (compute_service.py analog) --------------------------------
+
+def test_data_service_roundtrip():
+    from horovod_tpu.data import RemoteDataset, serve_dataset
+    batches = [np.full((2,), i) for i in range(5)]
+    worker = serve_dataset(iter(batches))
+    try:
+        port = worker.httpd.server_address[1]
+        ds = RemoteDataset(endpoints=[f"127.0.0.1:{port}"])
+        out = [int(b[0]) for b in ds]
+        assert out == [0, 1, 2, 3, 4]
+    finally:
+        worker.stop()
+
+
+def test_data_service_registry_and_two_workers():
+    from horovod_tpu.data import RemoteDataset, serve_dataset
+    from horovod_tpu.runner.http_server import KVStoreServer
+    kv = KVStoreServer()
+    rport = kv.start()
+    w0 = serve_dataset([("a", i) for i in range(3)], worker_id=0,
+                       rendezvous_addr="127.0.0.1", rendezvous_port=rport)
+    w1 = serve_dataset([("b", i) for i in range(3)], worker_id=1,
+                       rendezvous_addr="127.0.0.1", rendezvous_port=rport)
+    try:
+        ds = RemoteDataset(rendezvous_addr="127.0.0.1",
+                           rendezvous_port=rport, num_workers=2)
+        items = sorted(list(ds))
+        assert items == sorted(
+            [("a", i) for i in range(3)] + [("b", i) for i in range(3)])
+    finally:
+        w0.stop(); w1.stop(); kv.stop()
