@@ -225,7 +225,12 @@ def allgather(tensor, name: Optional[str] = None,
     (allgatherv, MPI_Allgatherv analog) is supported: in emulated mode pass a
     *list* of per-rank tensors; in multi-process mode ragged local dim0 is
     handled via a size exchange + pad-to-max + slice (the reference controller
-    gathers recvcounts the same way, collective_operations.h:126)."""
+    gathers recvcounts the same way, collective_operations.h:126).
+
+    HOROVOD_HIERARCHICAL_ALLGATHER (MPIHierarchicalAllgather,
+    mpi_operations.cc) is accepted and maps to the flat lax.all_gather —
+    XLA lowers it with the torus-native hierarchical decomposition the
+    reference's node-leader gather approximates in software."""
     axis = _axis()
     members = _members(process_set)
     if _axis_bound(axis):

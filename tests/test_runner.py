@@ -241,3 +241,34 @@ def test_process_set_subset_across_processes(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "rank0 PS OK" in proc.stdout and "rank1 PS OK" in proc.stdout
+
+
+@pytest.mark.integration
+def test_run_api_gathers_results(tmp_path):
+    """horovod_tpu.run(fn, np=2) returns per-rank results ordered by rank
+    (horovod.run, runner/__init__.py:95)."""
+    script = tmp_path / "runner_api.py"
+    script.write_text(f"""
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {REPO!r})
+
+def train_fn(scale):
+    import jax
+    jax.config.update('jax_platforms','cpu')
+    import horovod_tpu as hvd, jax.numpy as jnp
+    hvd.init()
+    v = hvd.allreduce(jnp.array([1.0 * (hvd.rank() + 1)]), op=hvd.Sum)
+    return {{"rank": hvd.rank(), "sum": float(v[0]), "scaled": scale * hvd.rank()}}
+
+from horovod_tpu import runner
+results = runner.run(train_fn, args=(10,), np=2)
+assert [r["rank"] for r in results] == [0, 1], results
+assert all(r["sum"] == 3.0 for r in results), results
+assert results[1]["scaled"] == 10
+print("RUN_API_OK")
+""")
+    proc = subprocess.run([sys.executable, str(script)], cwd=REPO,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RUN_API_OK" in proc.stdout
