@@ -175,13 +175,35 @@ def _refresh_world_from_rendezvous() -> None:
         "timed out waiting for a slot assignment after reset")
 
 
-def _reset() -> None:
+def _reset(refresh_world: bool = True) -> None:
     """Full reinit: shutdown the runtime, re-rendezvous, re-init
-    (common/elastic.py run_fn 'reinit' = shutdown + re-rendezvous)."""
+    (common/elastic.py run_fn 'reinit' = shutdown + re-rendezvous).
+
+    ``refresh_world=False`` for recovery from a collective failure with
+    UNCHANGED membership (HorovodInternalError): every rank received the
+    same error verdict and resets simultaneously into the same world, so
+    there is no new world version to wait for — the slot env is still
+    valid and only the JAX runtime needs rebuilding."""
     from .. import core as _core
     _core.shutdown()
     if os.environ.get("HOROVOD_ELASTIC") == "1":
-        _refresh_world_from_rendezvous()
+        if refresh_world:
+            _refresh_world_from_rendezvous()
+            # New world: generation = (world_version, 0).  Newly spawned
+            # workers get the same value from the driver (launch_support),
+            # so every member of the new world scopes its negotiation keys
+            # identically.
+            os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
+                f"{os.environ.get('HVD_TPU_WORLD_VERSION', '0')}.0"
+        else:
+            # Same world, in-place recovery: every rank received the same
+            # collective-failure verdict and resets together — bump the
+            # same-world counter so the fresh negotiators never consume the
+            # previous incarnation's KV records.
+            cur = os.environ.get("HVD_TPU_NEGOTIATION_GEN", "0.0")
+            w, _, c = cur.partition(".")
+            os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
+                f"{w}.{int(c or 0) + 1}"
         import jax
         try:
             from jax._src import distributed as _jdist
@@ -232,10 +254,11 @@ def run(func):
         notification_manager.register_listener(state)
         skip_sync = False
         reset_required = False
+        refresh_world = True
         try:
             while True:
                 if reset_required:
-                    _reset()
+                    _reset(refresh_world=refresh_world)
                     # Restore AFTER the backend reset: the in-memory commit
                     # holds host (numpy) copies, so restore re-materializes
                     # arrays on the NEW backend.  (Restoring before the
@@ -253,10 +276,12 @@ def run(func):
                     get_logger().info(
                         "elastic: collective failure — restoring last commit")
                     skip_sync = False
+                    refresh_world = False  # membership unchanged
                 except HostsUpdatedInterrupt as e:
                     get_logger().info(
                         "elastic: host membership changed — reinitializing")
                     skip_sync = e.skip_sync
+                    refresh_world = True
                 reset_required = True
         finally:
             notification_manager.remove_listener(state)

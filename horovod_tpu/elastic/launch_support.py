@@ -39,6 +39,9 @@ def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
             _config.HOROVOD_RENDEZVOUS_PORT: str(port),
             "HOROVOD_ELASTIC": "1",
             "HVD_TPU_WORLD_VERSION": str(world_version),
+            # Negotiation generation of the spawned world (matches the
+            # survivors' post-refresh value — see elastic._reset).
+            "HVD_TPU_NEGOTIATION_GEN": f"{world_version}.0",
             # Spawn-time discovery sequence: the notification manager
             # baselines here so pre-spawn updates are not replayed and
             # post-spawn ones are never missed.

@@ -78,6 +78,11 @@ class Negotiator:
             cfg.stall_shutdown_time_seconds, size)
         self._epochs: Dict[str, int] = {}
         self._inval_seen = 0  # last observed cross-rank invalidation seq
+        # Negotiation generation: bumped by elastic resets (all ranks reset
+        # together) so a fresh negotiator never consumes KV records left by
+        # its previous incarnation — stale verdicts would let one rank race
+        # past a renegotiation and deadlock the rest.
+        self._gen = os.environ.get("HVD_TPU_NEGOTIATION_GEN", "0")
         self.join_round = 0
         self._coordinating = set()     # (name, epoch) in a bg thread NOW
         self._coordinated_done = set()  # (name, epoch) already coordinated
@@ -118,7 +123,7 @@ class Negotiator:
             self._publish_invalidation(name)
         epoch = self._epochs.get(name, 0)
         self._epochs[name] = epoch + 1
-        scope = "negotiate"
+        scope = f"negotiate@{self._gen}"
         req_key = f"req/{name}/{epoch}/{self.rank}"
         resp_key = f"resp/{name}/{epoch}"
         sig = {"dtype": dtype, "shape": list(shape), "op": kind_id,
@@ -161,7 +166,7 @@ class Negotiator:
     def _publish_invalidation(self, name: str) -> None:
         seq = self._inval_seen + 1
         self._inval_seen = seq
-        self.client.put("negotiate", f"inval/{self.rank}",
+        self.client.put(f"negotiate@{self._gen}", f"inval/{self.rank}",
                         json.dumps({"seq": seq, "name": name}).encode())
 
     def _absorb_remote_invalidations(self) -> None:
@@ -180,7 +185,7 @@ class Negotiator:
         for r in range(self.size):
             if r == self.rank:
                 continue
-            raw = self.client.get("negotiate", f"inval/{r}")
+            raw = self.client.get(f"negotiate@{self._gen}", f"inval/{r}")
             if raw is None:
                 continue
             rec = json.loads(raw)
@@ -206,7 +211,7 @@ class Negotiator:
         now = time.time()
         if now - getattr(self, "_join_check_ts", 0) < 0.05:
             return getattr(self, "_join_check_val", False)
-        val = self.client.get("join", "active") is not None
+        val = self.client.get(f"join@{self._gen}", "active") is not None
         self._join_check_ts = now
         self._join_check_val = val
         return val
@@ -215,14 +220,14 @@ class Negotiator:
         """rank -> join order timestamp for the given join round."""
         out = {}
         for r in range(self.size):
-            raw = self.client.get(f"join{round_}", str(r))
+            raw = self.client.get(f"join{round_}@{self._gen}", str(r))
             if raw is not None:
                 out[r] = json.loads(raw)["order"]
         return out
 
     def announce_join(self, round_: int) -> None:
-        self.client.put("join", "active", b"1")
-        self.client.put(f"join{round_}", str(self.rank),
+        self.client.put(f"join@{self._gen}", "active", b"1")
+        self.client.put(f"join{round_}@{self._gen}", str(self.rank),
                         json.dumps({"order": time.time()}).encode())
         self._join_check_val = True
         self._join_check_ts = time.time()
@@ -231,7 +236,7 @@ class Negotiator:
         """The last-joining rank retires the round."""
         if self.rank == last_rank:
             try:
-                self.client.delete("join", "active")
+                self.client.delete(f"join@{self._gen}", "active")
             except Exception:
                 pass
         self._join_check_val = False
@@ -267,10 +272,10 @@ class Negotiator:
     def _announce_for_coordinator(self, name: str, epoch: int, sig: dict,
                                   kind: str) -> None:
         self._annc_seq = getattr(self, "_annc_seq", 0) + 1
-        self.client.put("annc", f"{self.rank}/{self._annc_seq}",
+        self.client.put(f"annc@{self._gen}", f"{self.rank}/{self._annc_seq}",
                         json.dumps({"name": name, "epoch": epoch,
                                     "sig": sig, "kind": kind}).encode())
-        self.client.put("annc", f"{self.rank}/seq",
+        self.client.put(f"annc@{self._gen}", f"{self.rank}/seq",
                         str(self._annc_seq).encode())
 
     def service_announcements(self, seen: Dict[int, int]) -> None:
@@ -279,14 +284,14 @@ class Negotiator:
         joinop record flow exactly as in the inline path); the (name, epoch)
         is marked so rank 0's own zero-dispatch doesn't coordinate twice."""
         for r in range(1, self.size):
-            raw = self.client.get("annc", f"{r}/seq")
+            raw = self.client.get(f"annc@{self._gen}", f"{r}/seq")
             if raw is None:
                 continue
             latest = int(raw)
             while seen.get(r, 0) < latest:
                 s = seen.get(r, 0) + 1
                 seen[r] = s
-                rec = json.loads(self.client.get("annc", f"{r}/{s}"))
+                rec = json.loads(self.client.get(f"annc@{self._gen}", f"{r}/{s}"))
                 key = (rec["name"], rec["epoch"])
                 with self._coord_lock:
                     if key in self._coordinating or \
@@ -312,19 +317,21 @@ class Negotiator:
     def publish_joinop(self, name: str, epoch: int, sig: dict,
                        kind: str) -> None:
         self._joinop_seq = getattr(self, "_joinop_seq", 0) + 1
-        self.client.put("joinops", str(self._joinop_seq),
+        self.client.put(f"joinops@{self._gen}", str(self._joinop_seq),
                         json.dumps({"name": name, "epoch": epoch,
                                     "sig": sig, "kind": kind}).encode())
-        self.client.put("joinops", "seq", str(self._joinop_seq).encode())
+        self.client.put(f"joinops@{self._gen}", "seq",
+                        str(self._joinop_seq).encode())
 
     def poll_joinop(self, seen: int):
-        raw = self.client.get("joinops", "seq")
+        raw = self.client.get(f"joinops@{self._gen}", "seq")
         if raw is None:
             return seen, None
         seq = int(raw)
         if seq <= seen:
             return seen, None
-        rec = json.loads(self.client.get("joinops", str(seen + 1)))
+        rec = json.loads(self.client.get(f"joinops@{self._gen}",
+                                         str(seen + 1)))
         return seen + 1, rec
 
     def _coordinate(self, name: str, epoch: int, my_sig: dict,
@@ -349,7 +356,7 @@ class Negotiator:
                 for r in range(self.size):
                     if r in arrived:
                         continue
-                    raw = self.client.get("negotiate",
+                    raw = self.client.get(f"negotiate@{self._gen}",
                                           f"req/{name}/{epoch}/{r}")
                     if raw is None:
                         continue
@@ -415,7 +422,7 @@ class Negotiator:
             self.msgtable.erase(tbl_key)
 
     def _publish(self, name: str, epoch: int, err: str) -> None:
-        self.client.put("negotiate", f"resp/{name}/{epoch}",
+        self.client.put(f"negotiate@{self._gen}", f"resp/{name}/{epoch}",
                         json.dumps({"error": err}).encode())
 
     def _wait_response(self, name: str, resp_key: str,
@@ -423,7 +430,7 @@ class Negotiator:
         deadline = time.time() + self._timeout
         last_announce_check = time.time()
         while time.time() < deadline:
-            raw = self.client.get("negotiate", resp_key)
+            raw = self.client.get(f"negotiate@{self._gen}", resp_key)
             if raw is not None:
                 return json.loads(raw).get("error", "")
             now = time.time()

@@ -452,3 +452,57 @@ def test_elastic_scale_up_end_to_end(tmp_path):
     assert m, proc.stdout[-2000:]
     sizes = [int(x) for x in m.group(1).split(",")]
     assert 1 in sizes and 2 in sizes and sizes == sorted(sizes)
+
+
+FAILURE_RECOVERY_WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys, os; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+state = hvd.elastic.TpuState(params={{"w": jnp.zeros((2,))}}, batch=0)
+crashed = {{"done": False}}
+
+@hvd.elastic.run
+def train(state):
+    while state.batch < 10:
+        out = hvd.allreduce(jnp.ones((2,)), op=hvd.Sum, name="g")
+        assert abs(float(out[0]) - hvd.size()) < 1e-6
+        state.params = {{"w": state.params["w"] + 1.0}}
+        state.batch += 1
+        if state.batch % 2 == 0:
+            state.commit()
+        if state.batch == 5 and not crashed["done"]:
+            crashed["done"] = True
+            raise hvd.HorovodInternalError("simulated ICI fault")
+    return float(state.params["w"][0])
+
+w = train(state)
+print(f"rank{{hvd.rank()}} RECOVERED size={{hvd.size()}} "
+      f"batches={{state.batch}} w={{w}}", flush=True)
+assert state.batch == 10 and w == 10.0
+"""
+
+
+@pytest.mark.integration
+def test_failure_recovery_same_world(tmp_path):
+    """HorovodInternalError with UNCHANGED membership: every rank restores
+    the last commit, re-initializes the runtime at the same world size
+    (fresh negotiation generation — stale KV records must not be consumed),
+    and completes with exact state."""
+    import subprocess
+    import sys
+    disc = tmp_path / "disc.sh"
+    disc.write_text("#!/bin/sh\necho localhost:2\n")
+    disc.chmod(0o755)
+    worker = tmp_path / "worker.py"
+    worker.write_text(FAILURE_RECOVERY_WORKER.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "2", "--max-np", "2",
+         "--host-discovery-script", str(disc),
+         sys.executable, str(worker)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "rank0 RECOVERED size=2 batches=10 w=10.0" in proc.stdout
+    assert "rank1 RECOVERED size=2 batches=10 w=10.0" in proc.stdout
