@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import core as _core
+from ..ops.collective_ops import hierarchical_allreduce  # noqa: F401
 
 
 def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
