@@ -55,7 +55,22 @@ def bench_bert():
     }))
 
 
+def _wait_for_devices(retries: int = 5, delay_s: float = 120.0):
+    """The one-chip relay occasionally reports UNAVAILABLE or hangs briefly;
+    retry device discovery before declaring the benchmark dead."""
+    for attempt in range(retries):
+        try:
+            jax.devices()
+            return
+        except Exception as e:
+            print(f"bench: device init failed (attempt {attempt + 1}/"
+                  f"{retries}): {e}", file=sys.stderr)
+            time.sleep(delay_s)
+    jax.devices()  # final attempt; let the real error propagate
+
+
 def main():
+    _wait_for_devices()
     if os.environ.get("BENCH_MODEL", "").startswith("bert"):
         hvd.init()
         bench_bert()
