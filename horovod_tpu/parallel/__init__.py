@@ -89,7 +89,12 @@ def shard_step(fn: Callable,
 
     cache = {}
 
-    def wrapper(*args):
+    def wrapper(*args, **kwargs):
+        if kwargs:
+            raise TypeError(
+                "shard_step-wrapped functions take positional arguments "
+                "only (shard_map in_specs are positional); pass "
+                f"{sorted(kwargs)} positionally")
         key = len(args)
         if key not in cache:
             cache[key] = build(key)
