@@ -34,7 +34,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from .. import config as _config
-from ..exceptions import HorovodInternalError, DuplicateNameError
+from ..exceptions import HorovodInternalError
 from ..utils import get_logger
 
 # Op-kind id bases; the per-op parameter (ReduceOp value, broadcast root)
@@ -208,11 +208,13 @@ class Negotiator:
     # join, on every rank.
 
     def join_active(self) -> bool:
-        now = time.time()
-        if now - getattr(self, "_join_check_ts", 0) < 0.05:
-            return getattr(self, "_join_check_val", False)
+        """Fresh KV read every call: a cached (un-negotiated) dispatch issued
+        after a peer joined would block in a collective the joined rank's
+        service loop never learns about, so the fast path must see the join
+        marker as soon as it exists.  (A sub-millisecond window remains
+        between this read and the dispatch — closing it fully needs cached
+        dispatches to publish replayable signatures; see TODO.md.)"""
         val = self.client.get(f"join@{self._gen}", "active") is not None
-        self._join_check_ts = now
         self._join_check_val = val
         return val
 
