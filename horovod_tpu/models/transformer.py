@@ -42,7 +42,7 @@ class TransformerConfig:
     causal: bool = True              # GPT style; False = BERT style
     dtype: Any = jnp.bfloat16
     axis_name: str = "hvd"
-    seq_parallel: Optional[str] = None   # None | 'ring' | 'ulysses'
+    seq_parallel: Optional[str] = None   # None|'ring'|'ring_striped'|'ulysses'
     attention_impl: Optional[str] = None  # None (dense) | 'flash' (Pallas)
     remat: bool = False
 
@@ -79,7 +79,8 @@ class SelfAttention(nn.Module):
             raise ValueError(
                 f"unknown attention_impl {cfg.attention_impl!r}; "
                 f"expected None or 'flash'")
-        if cfg.attention_impl == "flash" and cfg.seq_parallel == "ring":
+        if cfg.attention_impl == "flash" and \
+                cfg.seq_parallel in ("ring", "ring_striped"):
             raise ValueError(
                 "attention_impl='flash' composes with seq_parallel=None or "
                 "'ulysses'; ring attention performs its own blockwise "
@@ -90,9 +91,10 @@ class SelfAttention(nn.Module):
 
             def local_attn(q, k, v, *, causal, scale=None):
                 return flash_attention(q, k, v, causal=causal, scale=scale)
-        if cfg.seq_parallel == "ring":
+        if cfg.seq_parallel in ("ring", "ring_striped"):
             out = ring_attention(q, k, v, axis_name=cfg.axis_name,
-                                 causal=cfg.causal)
+                                 causal=cfg.causal,
+                                 striped=cfg.seq_parallel == "ring_striped")
         elif cfg.seq_parallel == "ulysses":
             out = ulysses_attention(q, k, v, axis_name=cfg.axis_name,
                                     causal=cfg.causal,
@@ -136,13 +138,21 @@ class Transformer(nn.Module):
                        embedding_init=nn.initializers.normal(0.02),
                        dtype=cfg.dtype, name="wte")
         if positions is None:
-            positions = jnp.arange(S)[None, :]
-            if cfg.seq_parallel is not None:
-                # Sequence-sharded: this shard holds global tokens
-                # [idx*S, (idx+1)*S) — offset the position embedding or every
-                # shard but the first would silently embed positions 0..S-1.
-                from jax import lax as _lax
-                positions = positions + _lax.axis_index(cfg.axis_name) * S
+            if cfg.seq_parallel == "ring_striped":
+                # Striped layout: this shard holds global tokens
+                # [idx, idx+n, idx+2n, ...].
+                from ..parallel.ring import striped_positions
+                positions = striped_positions(
+                    S, axis_name=cfg.axis_name)[None, :]
+            else:
+                positions = jnp.arange(S)[None, :]
+                if cfg.seq_parallel is not None:
+                    # Block-sharded: this shard holds global tokens
+                    # [idx*S, (idx+1)*S) — offset the position embedding or
+                    # every shard but the first would silently embed 0..S-1.
+                    from jax import lax as _lax
+                    positions = positions + _lax.axis_index(
+                        cfg.axis_name) * S
         pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
                            embedding_init=nn.initializers.normal(0.01),
                            dtype=cfg.dtype, name="wpe")(positions)

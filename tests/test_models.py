@@ -139,3 +139,23 @@ def test_remat_matches_no_remat(hvd8):
     a = Transformer(TINY).apply(params, tokens)
     b = Transformer(cfg_r).apply(params, tokens)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ring_striped_transformer_matches_dense(hvd8):
+    """seq_parallel='ring_striped': striped tokens + automatic striped
+    positions must reproduce the dense model's logits after unstriping."""
+    from horovod_tpu.parallel.ring import stripe_sequence, unstripe_sequence
+    cfg_s = dataclasses.replace(TINY, seq_parallel="ring_striped")
+    model_d = Transformer(TINY)
+    model_s = Transformer(cfg_s)
+    tokens = jnp.asarray(np.random.RandomState(6).randint(0, 128, (2, 64)))
+    params = model_d.init(jax.random.PRNGKey(0), tokens)
+    dense_logits = model_d.apply(params, tokens)
+    striped_tokens = stripe_sequence(tokens, N)
+    mesh = hvd8.mesh()
+    sp_logits = jax.jit(jax.shard_map(
+        lambda t: model_s.apply(params, t), mesh=mesh,
+        in_specs=P(None, "hvd"), out_specs=P(None, "hvd")))(striped_tokens)
+    np.testing.assert_allclose(
+        np.asarray(unstripe_sequence(sp_logits, N)),
+        np.asarray(dense_logits), rtol=2e-3, atol=2e-3)
