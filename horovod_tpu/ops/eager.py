@@ -223,13 +223,13 @@ class EagerEngine:
                         [jnp.asarray(t) for t in tensors])]
                 if self.topo.emulated:
                     pairs = [self._as_stacked(t, stacked) for t in tensors]
-                    stacked = [p[0] for p in pairs]
+                    stacked_ts = [p[0] for p in pairs]
                     if tl is None:
-                        outs = self._stacked_run(kind, body, stacked,
+                        outs = self._stacked_run(kind, body, stacked_ts,
                                                  static_params, self.mesh)
                     else:
                         with tl.activity(label, "XLA_EXECUTE"):
-                            outs = self._stacked_run(kind, body, stacked,
+                            outs = self._stacked_run(kind, body, stacked_ts,
                                                      static_params, self.mesh)
                     if not isinstance(outs, (tuple, list)):
                         outs = [outs]
