@@ -65,6 +65,7 @@ from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
 
 from . import parallel  # noqa: F401
+from .parallel import shard_step  # noqa: F401  (hvd.shard_step idiom)
 
 from . import runner  # noqa: F401
 from . import elastic  # noqa: F401

@@ -37,7 +37,11 @@ class _BatchHandler(BaseHTTPRequestHandler):
         try:
             payload = self.server.batch_queue.get(timeout=30)
         except queue.Empty:
-            self.send_response(204)  # drained / producer finished
+            # The None sentinel is consumed exactly once, so every reader
+            # after the first must learn of exhaustion from the flag —
+            # otherwise a second trainer retries 204s forever.
+            code = 410 if self.server.exhausted else 204
+            self.send_response(code)
             self.end_headers()
             return
         if payload is None:
@@ -73,9 +77,13 @@ class DataServiceWorker:
         port = self.httpd.server_address[1]
 
         def produce():
-            for item in self.dataset:
-                self.httpd.batch_queue.put(pickle.dumps(item))
-            self.httpd.batch_queue.put(None)
+            # Sentinel goes out even if the dataset iterable raises, so
+            # readers see 410 (exhausted) rather than polling 204 forever.
+            try:
+                for item in self.dataset:
+                    self.httpd.batch_queue.put(pickle.dumps(item))
+            finally:
+                self.httpd.batch_queue.put(None)
 
         threading.Thread(target=produce, daemon=True,
                          name="hvd-data-producer").start()
@@ -137,9 +145,15 @@ class RemoteDataset:
                 try:
                     resp = urllib.request.urlopen(f"http://{ep}/next",
                                                   timeout=60)
+                    # 204 = producer drained-but-alive (queue empty for the
+                    # server's wait window): retry later.  urllib raises
+                    # HTTPError only for status >= 400, so this must be an
+                    # explicit status check, not an except branch.
+                    if resp.status == 204:
+                        continue
                     yield pickle.loads(resp.read())
                 except urllib.error.HTTPError as e:
-                    if e.code in (410, 204):
+                    if e.code == 410:  # producer exhausted: drop endpoint
                         live.remove(ep)
                     else:
                         raise

@@ -267,10 +267,14 @@ def _allgatherv_emulated(tensors: List, members) -> List:
             jnp.asarray(tensors[r]) for r in range(n)]
 
 
-def _allgatherv_multiproc(tensor, members, name):
-    """Ragged allgather, multi-process: exchange dim0 sizes (fixed shape),
-    pad to max, gather, slice+concat — the static-shape-safe allgatherv
-    (SURVEY.md §7 "dynamic shapes")."""
+def _allgatherv_parts(tensor, name):
+    """Raw ragged gather: exchange dim0 sizes (fixed shape), pad to max,
+    gather, slice per rank — the static-shape-safe allgatherv
+    (SURVEY.md §7 "dynamic shapes").  Returns (per-rank blocks, sizes);
+    a joined rank's block is empty (its size announcement is 0).
+
+    The two dispatches here are mirrored one-to-one by the join replay
+    (ops/eager.py _replay_allgather_joinop) — change them together."""
     eng = _engine()
     n = eng.n
     t = np.asarray(tensor)
@@ -291,11 +295,21 @@ def _allgatherv_multiproc(tensor, members, name):
     gathered = np.asarray(eng.run("allgather", body,
                                   [jnp.asarray(padded)], (max_rows,),
                                   lambda ts: [ts[0][None]], name=name)[0])
-    sel = range(n) if members is None else members
+    return [gathered[r, :sizes[r]] for r in range(n)], sizes
+
+
+def _allgatherv_multiproc(tensor, members, name):
+    """Ragged allgather, multi-process: member blocks concatenated."""
+    eng = _engine()
+    n = eng.n
     if members is not None and _core.rank() not in set(members):
-        return jnp.asarray(t)
-    return jnp.asarray(np.concatenate(
-        [gathered[r, :sizes[r]] for r in sel], axis=0))
+        # Non-members still participate in the global exchange (the run is
+        # SPMD-total over all processes) but keep their input.
+        _allgatherv_parts(tensor, name)
+        return jnp.asarray(tensor)
+    blocks, _ = _allgatherv_parts(tensor, name)
+    sel = range(n) if members is None else members
+    return jnp.asarray(np.concatenate([blocks[r] for r in sel], axis=0))
 
 
 def allgather_async(tensor, name=None,
@@ -417,20 +431,24 @@ def _alltoallv_eager(tensor, splits, members):
             outputs.append(jnp.asarray(np.concatenate(parts, axis=0)))
         received = jnp.asarray(sp.T.copy())
         return outputs, received
-    # Multi-process ragged path: gather splits, pad tensors to max rows,
-    # gather, then slice received blocks host-side.
+    # Multi-process ragged path: gather splits, gather ragged data blocks,
+    # then slice received sub-blocks host-side.  A joined rank contributes
+    # an EMPTY block to both gathers (ops/eager.py join replay) — its splits
+    # row stays all-zero, i.e. it sends nothing to anyone.
     sp_local = np.asarray(splits, dtype=np.int64)
-    all_splits = np.asarray(allgather(jnp.asarray(sp_local)[None, :]))
-    all_splits = all_splits.reshape(n, n)
-    max_rows = int(np.max(np.sum(all_splits, axis=1)))
+    sp_blocks, sp_sizes = _allgatherv_parts(jnp.asarray(sp_local)[None, :],
+                                            None)
+    all_splits = np.zeros((n, n), np.int64)
+    for src in range(n):
+        if sp_sizes[src]:
+            all_splits[src] = np.asarray(sp_blocks[src]).reshape(n)
     t = np.asarray(tensor)
-    padded = np.zeros((max_rows,) + t.shape[1:], dtype=t.dtype)
-    padded[:t.shape[0]] = t
-    gathered = np.asarray(allgather(jnp.asarray(padded)[None]))  # [n, max, ...]
+    data_blocks, _ = _allgatherv_parts(jnp.asarray(t), None)
     rank = _core.rank()
     offsets = np.concatenate(
         [np.zeros((n, 1), np.int64), np.cumsum(all_splits, axis=1)], axis=1)
-    parts = [gathered[src, offsets[src, rank]:offsets[src, rank + 1]]
+    parts = [np.asarray(data_blocks[src])[offsets[src, rank]:
+                                          offsets[src, rank + 1]]
              for src in range(n)]
     out = jnp.asarray(np.concatenate(parts, axis=0)) if parts else \
         jnp.zeros((0,) + t.shape[1:], t.dtype)

@@ -357,10 +357,14 @@ class EagerEngine:
             shapes.append(tuple(dims[i:i + nd]))
             i += nd
         if kind.startswith("allgather"):
-            # Ragged marker: joined ranks contribute an EMPTY slice (the
-            # allgatherv path pads/concats by announced sizes).
-            shapes = [tuple(0 if d < 0 else d for d in s) for s in shapes]
-        elif any(d < 0 for s in shapes for d in s):
+            # Allgather-family records replay the RAW inner dispatches of
+            # _allgatherv_multiproc one-to-one (re-entering the public
+            # hvd.allgather would nest a fresh size exchange no live rank
+            # ever issues and deadlock — the ragged path is two dispatches,
+            # and the coordinator publishes a joinop record for each).
+            self._replay_allgather_joinop(rec, kind, name, dtypes, shapes)
+            return
+        if any(d < 0 for s in shapes for d in s):
             get_logger().warning(
                 "join: cannot zero-fill collective %s; skipping", name)
             return
@@ -396,11 +400,71 @@ class EagerEngine:
             _pub.alltoall(zeros[0], name=name, process_set=ps)
         elif kind == "barrier":
             _pub.barrier()
-        elif kind in ("allgather", "allgather_sizes"):
-            _pub.allgather(zeros[0], name=name, process_set=ps)
         else:
             get_logger().warning("join: unsupported kind %s for %s; skipping",
                                  kind, name)
+
+    def _replay_allgather_joinop(self, rec: dict, kind: str, name: str,
+                                 dtypes, shapes) -> None:
+        """Zero-contribute to a live ranks' ragged allgather.
+
+        _allgatherv_multiproc (ops/__init__.py) issues exactly two raw
+        dispatches — a fixed-shape dim0-size exchange ("allgather_sizes",
+        [1] int64) then a pad-to-max gather ("allgather", [max_rows, ...]).
+        Each produces its own joinop record; this replays the matching raw
+        dispatch via eng.run under the recorded label/epoch, contributing 0
+        rows: value 0 in the size exchange, and an all-zero [max_rows, ...]
+        buffer in the main gather (sliced out by live ranks, since our
+        announced size is 0 — the reference's empty-slice join semantics,
+        torch JoinOp + allgather).  max_rows is recovered from the size
+        exchange this rank just serviced (the records of one public
+        allgather are adjacent: live ranks block on the size exchange
+        before negotiating the main gather)."""
+        from jax import lax as _lax
+        from . import collective_ops as _C
+        # Consume the size-exchange pairing slot the moment a main-gather
+        # record arrives — even if this record is then skipped — so a later
+        # allgather can never pair with a stale sizes vector.
+        sizes = None
+        if kind == "allgather":
+            sizes = getattr(self, "_join_gather_sizes", None)
+            self._join_gather_sizes = None
+        if rec["epoch"] < self.negotiator._epochs.get(name, 0):
+            return  # stale (already participated live); see _dispatch_joinop
+        axis = self.axis
+        self.negotiator._epochs[name] = rec["epoch"]
+        if kind == "allgather_sizes":
+            zero = jnp.zeros((1,), jnp.dtype(dtypes[0]))
+
+            def size_body(x):
+                return _C.allgather(x, axis_name=axis)
+
+            sizes = self.run("allgather_sizes", size_body, [zero], (),
+                             lambda ts: ts, name=name)[0]
+            self._join_gather_sizes = np.asarray(sizes).ravel()
+            return
+        # Main gather: dim0 was published as the ragged marker (-1); the
+        # true padded extent is max over the announced sizes.
+        if sizes is None or sizes.size == 0:
+            get_logger().warning(
+                "join: allgather record %s arrived without a preceding size "
+                "exchange; skipping (live ranks will time out with a named "
+                "error rather than hang silently)", name)
+            return
+        max_rows = int(sizes.max())
+        trailing = tuple(d for d in shapes[0][1:])
+        if any(d < 0 for d in trailing):
+            get_logger().warning(
+                "join: cannot reconstruct trailing dims for %s; skipping",
+                name)
+            return
+        zero = jnp.zeros((max_rows,) + trailing, jnp.dtype(dtypes[0]))
+
+        def body(x):
+            return _lax.all_gather(x, axis, axis=0)
+
+        self.run("allgather", body, [zero], (max_rows,),
+                 lambda ts: [ts[0][None]], name=name)
 
     def claim_name(self, name: Optional[str]):
         if name is None:

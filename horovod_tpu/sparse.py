@@ -40,6 +40,11 @@ def sparse_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
                          "gathers and sums)")
     topo = _core._require_init().topology
     n = topo.size
+    members = None if process_set is None or process_set.ranks is None \
+        else process_set.members()
+    # Averaging divides by the PARTICIPANT count (the dense allreduce's
+    # members semantics), not the world size.
+    n_avg = n if members is None else len(members)
 
     if isinstance(x, (list, tuple)):
         if not topo.emulated:
@@ -50,22 +55,33 @@ def sparse_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
     elif n == 1:
         return x
     else:
-        # Multi-process: ragged allgather of values and indices.
+        # Multi-process: ragged allgather of values and indices.  Non-members
+        # MUST still dispatch (the gathers are SPMD-total over all
+        # processes); allgather hands them their input back, and they return
+        # it unscaled (dense-path non-member convention).
         vals = _ops.allgather(x.data, name=f"{name}.vals" if name else None,
                               process_set=process_set)
         idxs = _ops.allgather(x.indices,
                               name=f"{name}.idx" if name else None,
                               process_set=process_set)
+        if members is not None and _core.rank() not in set(members):
+            return x
         out = jsparse.BCOO((vals, idxs), shape=x.shape)
         if op == ReduceOp.AVERAGE:
-            out = jsparse.BCOO((out.data / n, out.indices), shape=x.shape)
+            out = jsparse.BCOO((out.data / n_avg, out.indices), shape=x.shape)
         return out.sum_duplicates(nse=out.nse)
 
     shape = mats[0].shape
-    vals = jnp.concatenate([m.data for m in mats], axis=0)
-    idxs = jnp.concatenate([m.indices for m in mats], axis=0)
+    sel = set(range(n)) if members is None else set(members)
+    vals = jnp.concatenate([m.data for r, m in enumerate(mats) if r in sel],
+                           axis=0)
+    idxs = jnp.concatenate([m.indices for r, m in enumerate(mats) if r in sel],
+                           axis=0)
     if op == ReduceOp.AVERAGE:
-        vals = vals / n
+        vals = vals / n_avg
+    # Emulated mode keeps the single-BCOO contract for any process_set:
+    # the reduction over the MEMBER mats (the caller holds every "rank's"
+    # input already, so non-member passthrough carries no information).
     out = jsparse.BCOO((vals, idxs), shape=shape)
     return out.sum_duplicates(nse=out.nse)
 

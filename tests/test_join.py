@@ -113,3 +113,45 @@ def test_join_staggered_three_ranks(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(3):
         assert f"rank{r}: staggered ok last=0" in proc.stdout
+
+
+WORKER_JOIN_ALLGATHER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import numpy as np
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+if hvd.rank() == 1:
+    last = hvd.join()     # joined rank must service allgather-family replays
+    print(f"RANK1 joined, last={{last}}")
+else:
+    # Ragged allgather while rank 1 is joined: the joined rank contributes
+    # an EMPTY slice, so rank 0 gets exactly its own rows back.
+    out = hvd.allgather(jnp.arange(6.0).reshape(3, 2), name="ag")
+    assert out.shape == (3, 2), out.shape
+    assert np.allclose(np.asarray(out), np.arange(6.0).reshape(3, 2))
+    # allgather_object routes through the same ragged path.
+    objs = hvd.allgather_object({{"r": 0}}, name="agobj")
+    assert {{"r": 0}} in objs, objs
+    # alltoall with splits (splits gather + padded gather) while joined.
+    t = jnp.arange(4.0).reshape(4, 1)
+    outp, rsplits = hvd.alltoall(t, splits=jnp.asarray([2, 2]), name="a2a")
+    assert np.asarray(rsplits).tolist() == [2, 0], rsplits
+    assert np.allclose(np.asarray(outp)[:2, 0], [0.0, 1.0]), outp
+    last = hvd.join()
+    print(f"RANK0 allgather-family under join ok, last={{last}}")
+assert last == 0
+"""
+
+
+@pytest.mark.integration
+def test_join_allgather_family(tmp_path):
+    """Regression (ADVICE r1, medium): allgather/alltoallv/allgather_object
+    issued while a peer is joined used to deadlock — the joinop replay
+    re-entered the public ragged path and nested a size exchange no live
+    rank ever issued.  Replays now mirror the raw inner dispatches."""
+    proc = _run(WORKER_JOIN_ALLGATHER, tmp_path, "jag.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RANK1 joined, last=0" in proc.stdout
+    assert "RANK0 allgather-family under join ok, last=0" in proc.stdout
