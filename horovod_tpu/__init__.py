@@ -69,6 +69,7 @@ from .parallel import shard_step  # noqa: F401  (hvd.shard_step idiom)
 
 from . import runner  # noqa: F401
 from . import elastic  # noqa: F401
+from . import spark  # noqa: F401
 run = runner.run  # launcher API (reference: horovod.run, runner/__init__.py:95)
 
 from .process_sets import (  # noqa: F401
@@ -77,5 +78,5 @@ from .process_sets import (  # noqa: F401
 )
 
 from .exceptions import (  # noqa: F401
-    HorovodInternalError, HostsUpdatedInterrupt,
+    HorovodInternalError, HostsUpdatedInterrupt, CollectiveRejectedError,
 )

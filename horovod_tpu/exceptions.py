@@ -15,6 +15,16 @@ class HorovodInternalError(RuntimeError):
     """
 
 
+class CollectiveRejectedError(HorovodInternalError):
+    """A coordinator-published error verdict for a negotiated collective
+    (the ERROR Response of controller.cc ConstructResponse).
+
+    Distinct from other ``HorovodInternalError``s because a rejection is
+    SYMMETRIC: every participating rank raised it, so nobody entered the
+    device collective — a joined rank's replay loop may log it and keep
+    servicing, whereas a local timeout must propagate."""
+
+
 class HostsUpdatedInterrupt(Exception):
     """Raised when the set of participating hosts changes mid-training.
 

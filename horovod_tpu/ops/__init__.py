@@ -274,7 +274,7 @@ def _allgatherv_parts(tensor, name):
     a joined rank's block is empty (its size announcement is 0).
 
     The two dispatches here are mirrored one-to-one by the join replay
-    (ops/eager.py _replay_allgather_joinop) — change them together."""
+    (ops/eager.py _replay_allgather_record) — change them together."""
     eng = _engine()
     n = eng.n
     t = np.asarray(tensor)

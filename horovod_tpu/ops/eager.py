@@ -305,7 +305,15 @@ class EagerEngine:
     def join(self) -> int:
         """Signal no-more-data; service peers' collectives with zero
         contributions until every rank has joined; return the id of the last
-        rank to join (hvd.join semantics, torch/mpi_ops.py:1293)."""
+        rank to join (hvd.join semantics, torch/mpi_ops.py:1293).
+
+        Mechanism: follow live ranks' replayable dispatch streams
+        (ops/negotiation.py publish_dispatch) from this rank's own seq
+        position, zero-filling every record.  Replays negotiate/publish
+        like normal dispatches, so this rank's stream stays seq-aligned
+        with its peers' across join rounds.  The coordinator joining needs
+        no special path: its replay of a negotiated record coordinates that
+        record inline."""
         import time as _time
         if self.n == 1:
             return 0
@@ -316,37 +324,48 @@ class EagerEngine:
         neg = self.negotiator
         round_ = neg.join_round
         neg.announce_join(round_)
-        seen = getattr(neg, "_joinop_seen", 0)
-        annc_seen: Dict[int, int] = getattr(neg, "_annc_seen", {})
         deadline = _time.time() + neg._timeout
         while True:
-            joined = neg.joined_ranks(round_)
-            if len(joined) == self.n:
-                break
-            seen, rec = neg.poll_joinop(seen)
+            joined = neg.joined_ranks(round_)  # rank -> {"order","seq"}
+            live = [r for r in range(self.n) if r not in joined]
+            if not live:
+                # Everyone joined; drain up to the highest live-issued seq
+                # (a rank may have dispatched collectives and joined before
+                # this rank replayed them).
+                target = max(m["seq"] for m in joined.values())
+                if neg.dispatch_seq >= target:
+                    break
+                src = max(joined, key=lambda r: joined[r]["seq"])
+            else:
+                src = live[0]
+            rec = neg.poll_dispatch(src, neg.dispatch_seq + 1)
             if rec is not None:
-                self._dispatch_joinop(rec)
+                self._replay_record(rec)
+                # The replay published; neg.dispatch_seq advanced by one.
+                deadline = _time.time() + neg._timeout
                 continue
-            if self.topo.rank == 0:
-                neg.service_announcements(annc_seen)
             if _time.time() > deadline:
                 from ..exceptions import HorovodInternalError
                 raise HorovodInternalError(
                     f"join timed out; joined={sorted(joined)} of {self.n}")
-            _time.sleep(0.01)
-        neg._joinop_seen = seen
-        neg._annc_seen = annc_seen
-        last = max(joined, key=lambda r: (joined[r], r))
+            _time.sleep(0.005)
+        last = max(joined, key=lambda r: (joined[r]["order"], r))
         neg.finish_join_round(round_, last)
         neg.join_round += 1
         return last
 
-    def _dispatch_joinop(self, rec: dict) -> None:
+    def _replay_record(self, rec: dict) -> None:
         """Contribute zeros to a peer's collective (joined-ranks-contribute-
         zeros, JoinOp semantics).  The signature encodes everything needed to
-        reconstruct the call (KIND_IDS folding, ops/negotiation.py)."""
+        reconstruct the call (KIND_IDS folding, ops/negotiation.py).
+
+        Every path through here MUST advance this rank's dispatch_seq by
+        exactly one (the replayed dispatch publishes its own stream record);
+        a record that cannot be replayed is fatal — skipping it would stall
+        the stream and hang the live ranks inside the collective."""
         from .. import core as _core
         from .. import ops as _pub
+        from ..exceptions import HorovodInternalError
         sig, kind, name = rec["sig"], rec["kind"], rec["name"]
         dtypes = sig["dtype"].split(",")
         dims = sig["shape"]
@@ -358,22 +377,23 @@ class EagerEngine:
             i += nd
         if kind.startswith("allgather"):
             # Allgather-family records replay the RAW inner dispatches of
-            # _allgatherv_multiproc one-to-one (re-entering the public
+            # _allgatherv_parts one-to-one (re-entering the public
             # hvd.allgather would nest a fresh size exchange no live rank
             # ever issues and deadlock — the ragged path is two dispatches,
-            # and the coordinator publishes a joinop record for each).
-            self._replay_allgather_joinop(rec, kind, name, dtypes, shapes)
+            # each with its own stream record).
+            self._replay_allgather_record(rec, kind, name, dtypes, shapes)
             return
         if any(d < 0 for s in shapes for d in s):
-            get_logger().warning(
-                "join: cannot zero-fill collective %s; skipping", name)
-            return
-        # Stale record: this rank already participated in that epoch as a
-        # live rank before joining (e.g. a joinop published for a DIFFERENT
-        # rank's benefit) — replaying it would negotiate a finished epoch
-        # whose verdict may already be garbage-collected.
+            raise HorovodInternalError(
+                f"join: cannot zero-fill collective {name!r} "
+                f"(non-concrete shape in replay record)")
         if rec["epoch"] < self.negotiator._epochs.get(name, 0):
-            return
+            # Streams replay only records issued after this rank's own seq,
+            # which it never participated in — an older epoch here means the
+            # stream and epoch bookkeeping disagree.
+            raise HorovodInternalError(
+                f"join: replay record for {name!r} has epoch "
+                f"{rec['epoch']} < local {self.negotiator._epochs.get(name)}")
         zeros = [jnp.zeros(s, dtype=jnp.dtype(dt))
                  for s, dt in zip(shapes, dtypes)]
         # Align the local epoch counter with the negotiated epoch.
@@ -382,29 +402,59 @@ class EagerEngine:
         pre, post = sig.get("prescale", 1.0), sig.get("postscale", 1.0)
         ps = _core._require_init().process_set_table.get(
             sig.get("ps_id", 0))
-        if kind == "allreduce":
-            _pub.allreduce(zeros[0], op=_pub.ReduceOp(op_id), name=name,
-                           prescale_factor=pre, postscale_factor=post,
-                           process_set=ps)
-        elif kind == "grouped_allreduce":
-            _pub.grouped_allreduce(zeros, op=_pub.ReduceOp(op_id - 600),
-                                   name=name, prescale_factor=pre,
-                                   postscale_factor=post, process_set=ps)
-        elif kind == "broadcast":
-            _pub.broadcast(zeros[0], root_rank=op_id - 10000, name=name,
-                           process_set=ps)
-        elif kind == "reducescatter":
-            _pub.reducescatter(zeros[0], op=_pub.ReduceOp(op_id - 400),
-                               name=name, process_set=ps)
-        elif kind == "alltoall":
-            _pub.alltoall(zeros[0], name=name, process_set=ps)
-        elif kind == "barrier":
-            _pub.barrier()
-        else:
-            get_logger().warning("join: unsupported kind %s for %s; skipping",
-                                 kind, name)
+        if kind not in ("allreduce", "grouped_allreduce", "broadcast",
+                        "reducescatter", "alltoall", "barrier"):
+            raise HorovodInternalError(
+                f"join: unsupported kind {kind!r} in replay record "
+                f"for {name!r}")
+        seq_before = self.negotiator.dispatch_seq
+        try:
+            if kind == "allreduce":
+                _pub.allreduce(zeros[0], op=_pub.ReduceOp(op_id), name=name,
+                               prescale_factor=pre, postscale_factor=post,
+                               process_set=ps)
+            elif kind == "grouped_allreduce":
+                _pub.grouped_allreduce(zeros, op=_pub.ReduceOp(op_id - 600),
+                                       name=name, prescale_factor=pre,
+                                       postscale_factor=post, process_set=ps)
+            elif kind == "broadcast":
+                root = op_id - 10000
+                if root == self.topo.rank:
+                    # A joined root has no data; zeros would be silently
+                    # wrong.  Negotiated dispatches get an error verdict
+                    # from the coordinator; for the cached path, poison the
+                    # cache so the NEXT dispatch renegotiates and errors.
+                    get_logger().error(
+                        "broadcast %s has joined rank %d as root; receivers "
+                        "get zeros this once and an error on the next "
+                        "dispatch", name, root)
+                    self.negotiator.cache.invalidate(name)
+                    self.negotiator._publish_invalidation(name)
+                _pub.broadcast(zeros[0], root_rank=root, name=name,
+                               process_set=ps)
+            elif kind == "reducescatter":
+                _pub.reducescatter(zeros[0], op=_pub.ReduceOp(op_id - 400),
+                                   name=name, process_set=ps)
+            elif kind == "alltoall":
+                _pub.alltoall(zeros[0], name=name, process_set=ps)
+            elif kind == "barrier":
+                _pub.barrier()
+        except HorovodInternalError as e:
+            from ..exceptions import CollectiveRejectedError
+            if self.negotiator.dispatch_seq == seq_before or \
+                    not isinstance(e, CollectiveRejectedError):
+                # Nothing was published, or a LOCAL failure (e.g. verdict
+                # timeout) that is not symmetric across ranks — live ranks
+                # may be inside the device collective expecting our zeros,
+                # so continuing to service would hang them silently.
+                raise
+            # A coordinator rejection (e.g. joined broadcast root) raised
+            # on every rank symmetrically AFTER the stream record was
+            # published — streams stay aligned, so servicing can continue.
+            get_logger().warning("join: replayed %s was rejected: %s",
+                                 name, e)
 
-    def _replay_allgather_joinop(self, rec: dict, kind: str, name: str,
+    def _replay_allgather_record(self, rec: dict, kind: str, name: str,
                                  dtypes, shapes) -> None:
         """Zero-contribute to a live ranks' ragged allgather.
 
@@ -422,15 +472,18 @@ class EagerEngine:
         before negotiating the main gather)."""
         from jax import lax as _lax
         from . import collective_ops as _C
+        from ..exceptions import HorovodInternalError
         # Consume the size-exchange pairing slot the moment a main-gather
-        # record arrives — even if this record is then skipped — so a later
-        # allgather can never pair with a stale sizes vector.
+        # record arrives so a later allgather can never pair with a stale
+        # sizes vector.
         sizes = None
         if kind == "allgather":
             sizes = getattr(self, "_join_gather_sizes", None)
             self._join_gather_sizes = None
         if rec["epoch"] < self.negotiator._epochs.get(name, 0):
-            return  # stale (already participated live); see _dispatch_joinop
+            raise HorovodInternalError(
+                f"join: replay record for {name!r} has epoch "
+                f"{rec['epoch']} < local {self.negotiator._epochs.get(name)}")
         axis = self.axis
         self.negotiator._epochs[name] = rec["epoch"]
         if kind == "allgather_sizes":
@@ -446,18 +499,14 @@ class EagerEngine:
         # Main gather: dim0 was published as the ragged marker (-1); the
         # true padded extent is max over the announced sizes.
         if sizes is None or sizes.size == 0:
-            get_logger().warning(
-                "join: allgather record %s arrived without a preceding size "
-                "exchange; skipping (live ranks will time out with a named "
-                "error rather than hang silently)", name)
-            return
+            raise HorovodInternalError(
+                f"join: allgather record {name!r} arrived without a "
+                f"preceding size exchange (stream order violation)")
         max_rows = int(sizes.max())
         trailing = tuple(d for d in shapes[0][1:])
         if any(d < 0 for d in trailing):
-            get_logger().warning(
-                "join: cannot reconstruct trailing dims for %s; skipping",
-                name)
-            return
+            raise HorovodInternalError(
+                f"join: cannot reconstruct trailing dims for {name!r}")
         zero = jnp.zeros((max_rows,) + trailing, jnp.dtype(dtypes[0]))
 
         def body(x):

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -84,9 +83,13 @@ class Negotiator:
         # past a renegotiation and deadlock the rest.
         self._gen = os.environ.get("HVD_TPU_NEGOTIATION_GEN", "0")
         self.join_round = 0
-        self._coordinating = set()     # (name, epoch) in a bg thread NOW
-        self._coordinated_done = set()  # (name, epoch) already coordinated
-        self._coord_lock = threading.Lock()
+        # Replayable dispatch stream (the join protocol's backbone): every
+        # multiproc dispatch — cached or negotiated — appends a (seq,
+        # signature) record to this rank's ring-buffered KV stream.  Ranks
+        # advance in lockstep (same collectives, same program order), so
+        # seq N names the same collective on every rank.
+        self.dispatch_seq = 0
+        self._ring = int(os.environ.get("HVD_TPU_DISPATCH_RING", "1024"))
         self._timeout = float(os.environ.get(
             _config.HOROVOD_GLOO_TIMEOUT_SECONDS, "300"))
 
@@ -107,10 +110,18 @@ class Negotiator:
         self._absorb_remote_invalidations()
         status = self.cache.lookup(name, dtype, shape, kind_id, prescale,
                                    postscale, ps_id)
-        if status == self._HIT and not self.join_active():
-            # Cache fast path — suspended while any rank is joined so the
-            # coordinator can keep publishing joinop records (the bitvector-
-            # sync analog, controller.cc:845).
+        sig = {"dtype": dtype, "shape": list(shape), "op": kind_id,
+               "prescale": prescale, "postscale": postscale, "ps_id": ps_id}
+        if status == self._HIT:
+            # Cache fast path: no negotiation round-trip, but the dispatch
+            # is still PUBLISHED to this rank's replay stream — a rank that
+            # joined a microsecond ago replays it from there with zeros.
+            # This closes the join-onset race the old design had (a fresh
+            # join_active read per cached dispatch still left one RTT where
+            # a joined rank never learned of the collective; the analog of
+            # the reference's per-cycle cache-bitvector sync,
+            # controller.cc:845 CoordinateCacheAndState, is this stream).
+            self.publish_dispatch(name, self._epochs.get(name, 0), sig, kind)
             return
         if status == self._INVALID:
             # Shape/param change: renegotiate under a fresh epoch AND tell
@@ -126,17 +137,12 @@ class Negotiator:
         scope = f"negotiate@{self._gen}"
         req_key = f"req/{name}/{epoch}/{self.rank}"
         resp_key = f"resp/{name}/{epoch}"
-        sig = {"dtype": dtype, "shape": list(shape), "op": kind_id,
-               "prescale": prescale, "postscale": postscale, "ps_id": ps_id}
+        self.publish_dispatch(name, epoch, sig, kind)
         if timeline is not None:
             timeline.negotiate_start(name, kind.upper())
         self.client.put(scope, req_key, json.dumps(sig).encode())
-        self._maybe_announce(name, epoch, sig, kind)
         try:
-            with self._coord_lock:
-                bg_coordinated = ((name, epoch) in self._coordinating or
-                                  (name, epoch) in self._coordinated_done)
-            if self.rank == 0 and not bg_coordinated:
+            if self.rank == 0:
                 if epoch > 0:
                     # GC the previous epoch's verdict: everyone who needed it
                     # has moved on to this epoch (KV stays O(names x size)).
@@ -145,8 +151,7 @@ class Negotiator:
                     except Exception:
                         pass
                 self._coordinate(name, epoch, sig, timeline, kind)
-            verdict = self._wait_response(name, resp_key,
-                                          reannounce=(epoch, sig, kind))
+            verdict = self._wait_response(name, resp_key)
             # Own request record is consumed; drop it.
             try:
                 self.client.delete(scope, req_key)
@@ -156,7 +161,8 @@ class Negotiator:
             if timeline is not None:
                 timeline.negotiate_end(name, kind.upper())
         if verdict:
-            raise HorovodInternalError(
+            from ..exceptions import CollectiveRejectedError
+            raise CollectiveRejectedError(
                 f"collective {name!r} rejected by coordinator: {verdict}")
         self.cache.put(name, dtype, shape, kind_id, prescale, postscale,
                        ps_id)
@@ -196,43 +202,67 @@ class Negotiator:
     # -- join protocol (JoinOp, collective_operations.h:308) -----------------
     #
     # A rank with no more data calls join(): it publishes a round-scoped
-    # join marker and enters a service loop (ops/eager.py EagerEngine.join).
-    # While any rank is joined, the cache fast path is suspended (every op
-    # negotiates — the analog of the reference's per-cycle bitvector sync
-    # keeping joined ranks in the loop).  When the coordinator sees that the
-    # only missing ranks are joined ones, it publishes a "joinop" record
-    # describing the pending collective; each joined rank's service loop
-    # dispatches the SAME collective with zero tensors (the reference's
-    # joined-ranks-contribute-zeros semantics), so SPMD execution stays
-    # total over all processes.  join() returns the id of the last rank to
-    # join, on every rank.
+    # join marker carrying its dispatch_seq, then REPLAYS live ranks'
+    # dispatch streams from that position (ops/eager.py EagerEngine.join),
+    # zero-filling each record — the reference's joined-ranks-contribute-
+    # zeros semantics — so SPMD execution stays total over all processes.
+    # The cache fast path needs no suspension and no join_active read:
+    # every dispatch is in the stream before it can block.  Replays
+    # themselves negotiate/publish like any dispatch, which keeps every
+    # rank's seq counter aligned across join rounds.  join() returns the id
+    # of the last rank to join, on every rank.
+
+    def publish_dispatch(self, name: str, epoch: int, sig: dict,
+                         kind: str) -> None:
+        """Append one replayable record to this rank's dispatch stream
+        (ring-buffered in the KV store; slot reuse is the GC)."""
+        self.dispatch_seq += 1
+        rec = {"seq": self.dispatch_seq, "name": name, "epoch": epoch,
+               "sig": sig, "kind": kind}
+        self.client.put(f"disp@{self._gen}",
+                        f"{self.rank}/{self.dispatch_seq % self._ring}",
+                        json.dumps(rec).encode())
+
+    def poll_dispatch(self, src: int, seq: int) -> Optional[dict]:
+        """Record number ``seq`` from ``src``'s stream, or None if not yet
+        published.  A newer record in the slot means the publisher lapped
+        the ring before this rank replayed — unrecoverable, so fail loudly
+        (elastic reset can recover the job)."""
+        raw = self.client.get(f"disp@{self._gen}",
+                              f"{src}/{seq % self._ring}")
+        if raw is None:
+            return None
+        rec = json.loads(raw)
+        if rec["seq"] == seq:
+            return rec
+        if rec["seq"] > seq:
+            raise HorovodInternalError(
+                f"join replay stream overrun: rank {src} is "
+                f"{rec['seq'] - seq} dispatches ahead of this joined rank "
+                f"(ring size {self._ring}; raise HVD_TPU_DISPATCH_RING)")
+        return None  # slot still holds an older lap's record
 
     def join_active(self) -> bool:
-        """Fresh KV read every call: a cached (un-negotiated) dispatch issued
-        after a peer joined would block in a collective the joined rank's
-        service loop never learns about, so the fast path must see the join
-        marker as soon as it exists.  (A sub-millisecond window remains
-        between this read and the dispatch — closing it fully needs cached
-        dispatches to publish replayable signatures; see TODO.md.)"""
-        val = self.client.get(f"join@{self._gen}", "active") is not None
-        self._join_check_val = val
-        return val
+        """True while some rank's join round is open (used by the
+        coordinator's broadcast-root check; NOT on the dispatch hot path —
+        the replay stream made that read unnecessary)."""
+        return self.client.get(f"join@{self._gen}", "active") is not None
 
     def joined_ranks(self, round_: int) -> dict:
-        """rank -> join order timestamp for the given join round."""
+        """rank -> {"order": timestamp, "seq": final dispatch seq} for the
+        given join round."""
         out = {}
         for r in range(self.size):
             raw = self.client.get(f"join{round_}@{self._gen}", str(r))
             if raw is not None:
-                out[r] = json.loads(raw)["order"]
+                out[r] = json.loads(raw)
         return out
 
     def announce_join(self, round_: int) -> None:
         self.client.put(f"join@{self._gen}", "active", b"1")
         self.client.put(f"join{round_}@{self._gen}", str(self.rank),
-                        json.dumps({"order": time.time()}).encode())
-        self._join_check_val = True
-        self._join_check_ts = time.time()
+                        json.dumps({"order": time.time(),
+                                    "seq": self.dispatch_seq}).encode())
 
     def finish_join_round(self, round_: int, last_rank: int) -> None:
         """The last-joining rank retires the round."""
@@ -241,100 +271,6 @@ class Negotiator:
                 self.client.delete(f"join@{self._gen}", "active")
             except Exception:
                 pass
-        self._join_check_val = False
-        self._join_check_ts = 0.0
-        with self._coord_lock:
-            self._coordinated_done.clear()
-        if hasattr(self, "_announced"):
-            self._announced.clear()
-
-    def _maybe_announce(self, name: str, epoch: int, sig: dict,
-                        kind: str) -> None:
-        """If the coordinator (rank 0) has joined, the lowest-ranked survivor
-        announces the op so rank 0's service loop coordinates it.  Called at
-        submit time AND periodically while waiting for the verdict — rank 0
-        may join a moment after the first check (duplicate announcements are
-        deduped coordinator-side against the coordinated set)."""
-        if self.rank == 0 or not self.join_active():
-            return
-        joined = set(self.joined_ranks(self.join_round).keys())
-        if 0 not in joined:
-            return
-        survivors = [r for r in range(self.size) if r not in joined]
-        if not survivors or self.rank != min(survivors):
-            return
-        key = (name, epoch)
-        announced = getattr(self, "_announced", set())
-        self._announced = announced
-        if key in announced:
-            return
-        announced.add(key)
-        self._announce_for_coordinator(name, epoch, sig, kind)
-
-    def _announce_for_coordinator(self, name: str, epoch: int, sig: dict,
-                                  kind: str) -> None:
-        self._annc_seq = getattr(self, "_annc_seq", 0) + 1
-        self.client.put(f"annc@{self._gen}", f"{self.rank}/{self._annc_seq}",
-                        json.dumps({"name": name, "epoch": epoch,
-                                    "sig": sig, "kind": kind}).encode())
-        self.client.put(f"annc@{self._gen}", f"{self.rank}/seq",
-                        str(self._annc_seq).encode())
-
-    def service_announcements(self, seen: Dict[int, int]) -> None:
-        """Joined rank 0: coordinate ops announced by survivors.  Each new
-        announcement spawns a coordination thread (the op's verdict and
-        joinop record flow exactly as in the inline path); the (name, epoch)
-        is marked so rank 0's own zero-dispatch doesn't coordinate twice."""
-        for r in range(1, self.size):
-            raw = self.client.get(f"annc@{self._gen}", f"{r}/seq")
-            if raw is None:
-                continue
-            latest = int(raw)
-            while seen.get(r, 0) < latest:
-                s = seen.get(r, 0) + 1
-                seen[r] = s
-                rec = json.loads(self.client.get(f"annc@{self._gen}", f"{r}/{s}"))
-                key = (rec["name"], rec["epoch"])
-                with self._coord_lock:
-                    if key in self._coordinating or \
-                            key in self._coordinated_done:
-                        continue
-                    self._coordinating.add(key)
-
-                def coordinate(rec=rec, key=key):
-                    try:
-                        self._coordinate(rec["name"], rec["epoch"],
-                                         rec["sig"], None, rec["kind"])
-                    finally:
-                        with self._coord_lock:
-                            # Record completion BEFORE leaving the
-                            # in-flight set: rank 0's own zero-dispatch must
-                            # never re-coordinate a finished epoch.
-                            self._coordinated_done.add(key)
-                            self._coordinating.discard(key)
-
-                threading.Thread(target=coordinate, daemon=True,
-                                 name="hvd-join-coord").start()
-
-    def publish_joinop(self, name: str, epoch: int, sig: dict,
-                       kind: str) -> None:
-        self._joinop_seq = getattr(self, "_joinop_seq", 0) + 1
-        self.client.put(f"joinops@{self._gen}", str(self._joinop_seq),
-                        json.dumps({"name": name, "epoch": epoch,
-                                    "sig": sig, "kind": kind}).encode())
-        self.client.put(f"joinops@{self._gen}", "seq",
-                        str(self._joinop_seq).encode())
-
-    def poll_joinop(self, seen: int):
-        raw = self.client.get(f"joinops@{self._gen}", "seq")
-        if raw is None:
-            return seen, None
-        seq = int(raw)
-        if seq <= seen:
-            return seen, None
-        rec = json.loads(self.client.get(f"joinops@{self._gen}",
-                                         str(seen + 1)))
-        return seen + 1, rec
 
     def _coordinate(self, name: str, epoch: int, my_sig: dict,
                     timeline, kind: str = "allreduce") -> None:
@@ -345,14 +281,15 @@ class Negotiator:
         erased on every exit path — an error verdict (timeout, duplicate,
         stall shutdown) must not poison the name for the elastic retry.
 
-        Join-awareness: when every missing rank has a join marker, publish a
-        joinop record so their service loops contribute zeros; their
-        requests then arrive like any other rank's."""
+        Join-awareness: joined ranks replay the dispatch stream, so their
+        requests arrive here like any other rank's — no special casing
+        except the broadcast-root check (a joined root has no data to
+        broadcast; zeros would be silently wrong, so it is an error, the
+        reference's JoinOp + broadcast semantics)."""
         tbl_key = f"{name}#{epoch}"
         deadline = time.time() + self._timeout
         arrived = set()
         last_stall_check = time.time()
-        joinop_published = False
         try:
             while len(arrived) < self.size:
                 for r in range(self.size):
@@ -376,23 +313,6 @@ class Negotiator:
                     if timeline is not None:
                         timeline.negotiate_rank_ready(name, r)
                 now = time.time()
-                if not joinop_published and len(arrived) < self.size and \
-                        self.join_active():
-                    missing = set(range(self.size)) - arrived
-                    joined = set(self.joined_ranks(
-                        getattr(self, "join_round", 0)).keys())
-                    if missing and missing <= joined:
-                        if kind == "broadcast" and \
-                                (my_sig["op"] - KIND_IDS["broadcast"]) in \
-                                joined:
-                            self._publish(
-                                name, epoch,
-                                f"broadcast root rank "
-                                f"{my_sig['op'] - KIND_IDS['broadcast']} has "
-                                f"joined (no data to broadcast)")
-                            return
-                        self.publish_joinop(name, epoch, my_sig, kind)
-                        joinop_published = True
                 if now - last_stall_check > 1.0:
                     last_stall_check = now
                     st, report = self.stall.check(now)
@@ -414,6 +334,15 @@ class Negotiator:
                     return
                 if len(arrived) < self.size:
                     time.sleep(0.01)
+            if kind == "broadcast" and self.join_active():
+                root = my_sig["op"] - KIND_IDS["broadcast"]
+                if root in self.joined_ranks(
+                        getattr(self, "join_round", 0)):
+                    self._publish(
+                        name, epoch,
+                        f"broadcast root rank {root} has joined "
+                        f"(no data to broadcast)")
+                    return
             # Native validation errors embed the epoch-scoped table key;
             # surface the user-facing name instead.
             self._publish(name, epoch,
@@ -427,19 +356,12 @@ class Negotiator:
         self.client.put(f"negotiate@{self._gen}", f"resp/{name}/{epoch}",
                         json.dumps({"error": err}).encode())
 
-    def _wait_response(self, name: str, resp_key: str,
-                       reannounce=None) -> str:
+    def _wait_response(self, name: str, resp_key: str) -> str:
         deadline = time.time() + self._timeout
-        last_announce_check = time.time()
         while time.time() < deadline:
             raw = self.client.get(f"negotiate@{self._gen}", resp_key)
             if raw is not None:
                 return json.loads(raw).get("error", "")
-            now = time.time()
-            if reannounce is not None and now - last_announce_check > 0.5:
-                last_announce_check = now
-                epoch, sig, kind = reannounce
-                self._maybe_announce(name, epoch, sig, kind)
             time.sleep(0.005)
         raise HorovodInternalError(
             f"timed out waiting for negotiation verdict on {name!r}")
