@@ -17,10 +17,18 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+# Persistent XLA compilation cache (HVD_TPU_COMPILATION_CACHE is applied by
+# hvd.init): first run pays the full remote compile; every later run — and
+# crucially a retry inside a relay-outage window — is a disk hit.
+os.environ.setdefault("HVD_TPU_COMPILATION_CACHE",
+                      os.path.join(_REPO, ".jax_cache"))
 
 import jax
 import jax.numpy as jnp
@@ -55,18 +63,35 @@ def bench_bert():
     }))
 
 
-def _wait_for_devices(retries: int = 5, delay_s: float = 120.0):
-    """The one-chip relay occasionally reports UNAVAILABLE or hangs briefly;
-    retry device discovery before declaring the benchmark dead."""
+def _wait_for_devices():
+    """The one-chip relay can report UNAVAILABLE **or hang outright** in
+    jax.devices(); an in-process retry loop never fires on the hang.  Probe
+    in a killable subprocess first, and only touch the in-process backend
+    after a probe succeeds.  Exhausting the budget exits fast with a clear
+    message — a hanging final attempt would burn the driver's whole window
+    (round-1 BENCH died rc=124 exactly this way)."""
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "5"))
+    delay_s = float(os.environ.get("BENCH_PROBE_DELAY_S", "60"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
+    last = "unknown"
     for attempt in range(retries):
         try:
-            jax.devices()
-            return
-        except Exception as e:
-            print(f"bench: device init failed (attempt {attempt + 1}/"
-                  f"{retries}): {e}", file=sys.stderr)
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, text=True, timeout=probe_timeout)
+            if r.returncode == 0:
+                jax.devices()
+                return
+            tail = (r.stderr or "").strip().splitlines()
+            last = tail[-1] if tail else "?"
+        except subprocess.TimeoutExpired:
+            last = "probe hung (relay unresponsive)"
+        print(f"bench: device probe failed (attempt {attempt + 1}/"
+              f"{retries}): {last}", file=sys.stderr)
+        if attempt < retries - 1:
             time.sleep(delay_s)
-    jax.devices()  # final attempt; let the real error propagate
+    raise SystemExit(f"bench: no usable accelerator after {retries} probes; "
+                     f"last error: {last}")
 
 
 def main():
@@ -123,12 +148,17 @@ def main():
             params, batch_stats, opt_state, images, labels)
     float(loss)
 
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
     float(loss)
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     img_s = batch * ITERS / dt
     per_dev = img_s / nslots

@@ -14,3 +14,5 @@ from .transformer import (  # noqa: F401
     Transformer, TransformerConfig, create_gpt2, create_bert, lm_loss,
     GPT2_SMALL, GPT2_MEDIUM, GPT2_LARGE, BERT_BASE, BERT_LARGE,
 )
+
+from .mlp import MLP, MnistCNN, create_mlp  # noqa: F401

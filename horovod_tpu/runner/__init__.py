@@ -54,9 +54,13 @@ def run(func: Callable,
     with open(fn_path, "wb") as f:
         cloudpickle.dump((func, args, kwargs), f)
 
+    # Workers must resolve the same modules the caller sees (the pickled
+    # function is serialized by reference when its module is importable):
+    # ship the parent's full sys.path, not just its cwd.
+    parent_path = [p for p in [os.getcwd()] + sys.path if p]
     bootstrap = (
         "import pickle, os, sys; "
-        f"sys.path.insert(0, {os.getcwd()!r}); "
+        f"sys.path[:0] = [p for p in {parent_path!r} if p not in sys.path]; "
         f"fn, a, kw = pickle.load(open({fn_path!r}, 'rb')); "
         "r = fn(*a, **kw); "
         "rank = int(os.environ.get('HOROVOD_RANK', 0)); "

@@ -1,0 +1,19 @@
+"""horovod_tpu.spark — Spark cluster integration.
+
+Reference surface (horovod/spark/__init__.py): ``run``/``run_elastic`` (fn
+launchers over Spark barrier tasks) plus the Estimator layer
+(spark/common/estimator.py, keras/estimator.py) with its Store abstraction
+(spark/common/store.py).
+"""
+
+from ..spark_integration import run  # noqa: F401
+from .store import (  # noqa: F401
+    Store, FilesystemStore, LocalStore, shard_row_groups,
+)
+from .estimator import (  # noqa: F401
+    HorovodTpuEstimator, TpuTransformer,
+)
+
+# Reference alias (spark/keras/estimator.py KerasEstimator &co. collapse to
+# the one JAX estimator).
+HorovodEstimator = HorovodTpuEstimator

@@ -1,0 +1,425 @@
+"""Spark ML Estimator layer: ``HorovodTpuEstimator.fit(df)`` → trained
+``TpuTransformer``.
+
+Reference: horovod/spark/common/estimator.py:25 (HorovodEstimator: fit
+materializes the DataFrame to Parquet via a Store, trains inside
+horovod.spark.run, returns a Spark ML Transformer holding the model) and
+keras/estimator.py:98 (parameter surface).  The petastorm reader stack is
+replaced by plain pyarrow Parquet readers sharded by row group
+(store.shard_row_groups) — petastorm existed to stream Parquet into
+framework tensors; pyarrow → numpy → jax does that directly.
+
+Works with or without pyspark:
+
+* a **pyspark DataFrame** is written with ``df.write.parquet`` and training
+  launches on Spark barrier tasks (spark_integration.run);
+* a **pandas DataFrame** (or anything ``pandas.DataFrame(data)`` accepts)
+  is written with pyarrow and training launches through the local
+  multi-process launcher (``horovod_tpu.run``) — the same per-rank training
+  function either way.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from .store import Store, shard_row_groups
+
+
+def _is_spark_df(df) -> bool:
+    mod = type(df).__module__ or ""
+    return mod.startswith("pyspark.")
+
+
+def _resolve_loss(loss) -> Callable:
+    """Accept a callable(pred, label)->scalar or a named loss
+    (keras/estimator.py accepts keras loss names)."""
+    if callable(loss):
+        return loss
+    import jax.numpy as jnp
+    import optax
+    name = str(loss).lower()
+    if name in ("mse", "mean_squared_error"):
+        return lambda p, y: jnp.mean((p - y) ** 2)
+    if name in ("mae", "mean_absolute_error"):
+        return lambda p, y: jnp.mean(jnp.abs(p - y))
+    if name in ("sparse_categorical_crossentropy", "softmax_cross_entropy",
+                "cross_entropy"):
+        return lambda p, y: optax.softmax_cross_entropy_with_integer_labels(
+            p, y).mean()
+    raise ValueError(f"unknown loss {loss!r}; pass a callable(pred, label)")
+
+
+def _columns_to_array(table_cols: dict, cols: Sequence[str]):
+    """Assemble named columns into one [n, ...] numpy array: scalar columns
+    stack to [n, len(cols)]; a single list-valued column keeps its row
+    shape [n, k] (the reference's DenseVector feature column analog)."""
+    import numpy as np
+    arrs = []
+    for c in cols:
+        v = table_cols[c]
+        first = v[0]
+        if isinstance(first, (list, tuple, np.ndarray)):
+            arrs.append(np.stack([np.asarray(x) for x in v]))
+        else:
+            arrs.append(np.asarray(v))
+    if len(arrs) == 1:
+        return arrs[0]
+    return np.stack(arrs, axis=-1)
+
+
+def _read_shard(units, feature_cols, label_cols, filesystem=None):
+    """Read this rank's (file, row_group) units into (X, Y) numpy arrays."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    frames = []
+    for f, g in units:
+        src = filesystem.open(f, "rb") if filesystem is not None else f
+        frames.append(pq.ParquetFile(src).read_row_group(g).to_pydict())
+    if not frames:
+        return None, None
+    import itertools
+    merged = {c: list(itertools.chain.from_iterable(fr[c] for fr in frames))
+              for c in frames[0]}
+    X = _columns_to_array(merged, feature_cols)
+    Y = _columns_to_array(merged, label_cols)
+    return np.asarray(X), np.asarray(Y)
+
+
+def _estimator_train_fn(cfg: dict) -> List[dict]:
+    """Per-rank training body (reference: torch/remote.py:107 RemoteTrainer
+    — runs inside every Spark task / launcher worker)."""
+    if cfg.get("platform"):
+        import jax
+        jax.config.update("jax_platforms", cfg["platform"])
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    store: Store = cfg["store"]
+    model, loss_fn = cfg["model"], _resolve_loss(cfg["loss"])
+    batch = cfg["batch_size"]
+
+    fs = store.fs()
+    units = shard_row_groups(store.get_parquet_files(cfg["train_path"]),
+                             rank, size, filesystem=fs)
+    X, Y = _read_shard(units, cfg["feature_cols"], cfg["label_cols"],
+                       filesystem=fs)
+    if X is None:
+        raise ValueError(
+            f"rank {rank} received no parquet row groups; write the "
+            f"training data with at least {size} row groups "
+            f"(row_group_size small enough) or lower num_proc")
+    vX = vY = None
+    if cfg.get("val_path"):
+        vunits = shard_row_groups(
+            store.get_parquet_files(cfg["val_path"]), rank, size,
+            filesystem=fs)
+        vX, vY = _read_shard(vunits, cfg["feature_cols"], cfg["label_cols"],
+                             filesystem=fs)
+
+    rng = np.random.RandomState(cfg["seed"] + rank)
+    params = model.init(jax.random.PRNGKey(cfg["seed"]),
+                        jnp.asarray(X[:1]))
+    # Rank 0's initialization reaches everyone (BroadcastGlobalVariables
+    # idiom) — model.init is deterministic here, but user models may not be.
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(cfg["optimizer"])
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def grad_step(p, xb, yb):
+        return jax.value_and_grad(
+            lambda q: loss_fn(model.apply(q, xb), yb))(p)
+
+    @jax.jit
+    def eval_loss(p, xb, yb):
+        return loss_fn(model.apply(p, xb), yb)
+
+    # Equal step counts across ranks: collectives are SPMD-total, so every
+    # rank must dispatch the same number of optimizer updates per epoch
+    # (the reference equalizes via steps_per_epoch / join; MIN-allreduce of
+    # the local batch count is the static-shape-friendly form).
+    local_steps = max(len(X) // batch, 1)
+    nsteps = int(hvd.allreduce(jnp.asarray(float(local_steps)),
+                               op=hvd.Min, name="est.steps"))
+    history: List[dict] = []
+    for epoch in range(cfg["epochs"]):
+        order = rng.permutation(len(X)) if cfg["shuffle"] else \
+            np.arange(len(X))
+        ep_loss = 0.0
+        for i in range(nsteps):
+            sel = order[(i * batch) % len(X):(i * batch) % len(X) + batch]
+            if len(sel) < batch:  # wrap for short tails: static shapes
+                sel = np.concatenate([sel, order[:batch - len(sel)]])
+            loss, grads = grad_step(params, jnp.asarray(X[sel]),
+                                    jnp.asarray(Y[sel]))
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            ep_loss += float(loss)
+        entry = {"loss": float(hvd.allreduce(
+            jnp.asarray(ep_loss / nsteps), op=hvd.Average,
+            name="est.loss"))}
+        if cfg.get("val_path"):
+            # EVERY rank dispatches this collective even if its shard got no
+            # validation row groups (collectives are SPMD-total; a guarded
+            # dispatch would deadlock).  Weighted sum handles the raggedness.
+            if vX is not None and len(vX):
+                vloss, w = float(eval_loss(params, jnp.asarray(vX),
+                                           jnp.asarray(vY))), 1.0
+            else:
+                vloss, w = 0.0, 0.0
+            agg = hvd.allreduce(jnp.asarray([vloss * w, w]), op=hvd.Sum,
+                                name="est.val_loss")
+            if float(agg[1]) > 0:
+                entry["val_loss"] = float(agg[0]) / float(agg[1])
+        history.append(entry)
+        if cfg["verbose"] and rank == 0:
+            print(f"[estimator] epoch {epoch + 1}/{cfg['epochs']}: {entry}")
+    if rank == 0:
+        store.write_obj(store.get_checkpoint_path(cfg["run_id"]), {
+            "params": jax.device_get(params),
+            "history": history,
+            "feature_cols": cfg["feature_cols"],
+            "label_cols": cfg["label_cols"],
+        })
+    return history
+
+
+class HorovodTpuEstimator:
+    """Estimator with the reference's fit contract
+    (spark/common/estimator.py:25; parameter names follow
+    keras/estimator.py:98).
+
+    Args:
+      model: a flax ``linen.Module`` (anything with ``.init(rng, x)`` /
+        ``.apply(params, x)``).
+      optimizer: an optax gradient transformation.
+      loss: callable(pred, label) -> scalar, or one of "mse", "mae",
+        "sparse_categorical_crossentropy".
+      feature_cols / label_cols: DataFrame column names.
+      store: a ``Store`` (defaults to a LocalStore under /tmp).
+      validation: fraction in (0, 1) for a random split, or the name of a
+        boolean column selecting validation rows (estimator.py semantics).
+      num_proc: ranks to train with (Spark tasks or local processes).
+      worker_platform: force a jax platform inside workers (tests use
+        "cpu"; leave None on real TPU hosts).
+    """
+
+    def __init__(self,
+                 model=None,
+                 optimizer=None,
+                 loss=None,
+                 feature_cols: Optional[Sequence[str]] = None,
+                 label_cols: Optional[Sequence[str]] = None,
+                 batch_size: int = 32,
+                 epochs: int = 1,
+                 validation: Union[None, float, str] = None,
+                 store: Optional[Store] = None,
+                 num_proc: int = 1,
+                 shuffle: bool = True,
+                 verbose: int = 1,
+                 run_id: Optional[str] = None,
+                 random_seed: int = 0,
+                 worker_platform: Optional[str] = None):
+        if model is None or optimizer is None or loss is None:
+            raise ValueError("model, optimizer and loss are required")
+        if not feature_cols or not label_cols:
+            raise ValueError("feature_cols and label_cols are required")
+        _resolve_loss(loss)  # validate early
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.validation = validation
+        self.store = store
+        self.num_proc = num_proc
+        self.shuffle = shuffle
+        self.verbose = verbose
+        self.run_id = run_id
+        self.random_seed = random_seed
+        self.worker_platform = worker_platform
+        self.history: List[dict] = []
+
+    # -- data materialization (spark/common/util.py prepare_data analog) ----
+
+    def _write_parquet(self, df, store: Store):
+        """Materialize ``df`` under the store's intermediate paths; returns
+        (train_path, val_path or None)."""
+        train_path = store.get_train_data_path()
+        val_path = store.get_val_data_path()
+        if _is_spark_df(df):
+            train_df, val_df = self._split_spark(df)
+            train_df.write.mode("overwrite").parquet(train_path)
+            if val_df is not None:
+                val_df.write.mode("overwrite").parquet(val_path)
+            return train_path, (val_path if val_df is not None else None)
+        return self._write_pandas(df, store, train_path, val_path)
+
+    def _split_spark(self, df):
+        if self.validation is None:
+            return df, None
+        if isinstance(self.validation, str):
+            return (df.filter(f"NOT {self.validation}"),
+                    df.filter(self.validation))
+        frac = float(self.validation)
+        train_df, val_df = df.randomSplit([1.0 - frac, frac],
+                                          seed=self.random_seed)
+        return train_df, val_df
+
+    def _write_pandas(self, df, store: Store, train_path: str,
+                      val_path: str):
+        import numpy as np
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        if not isinstance(df, pd.DataFrame):
+            df = pd.DataFrame(df)
+        if self.validation is None:
+            train_df, val_df = df, None
+        elif isinstance(self.validation, str):
+            mask = df[self.validation].astype(bool)
+            train_df = df[~mask].drop(columns=[self.validation])
+            val_df = df[mask].drop(columns=[self.validation])
+        else:
+            rng = np.random.RandomState(self.random_seed)
+            mask = rng.rand(len(df)) < float(self.validation)
+            train_df, val_df = df[~mask], df[mask]
+
+        def write(frame, path):
+            # Enough row groups that every rank gets data
+            # (store.shard_row_groups shards by row group).
+            rows_per_group = max(1, len(frame) // max(self.num_proc * 4, 1))
+            fs = store.fs()
+            p = store._strip(path)
+            fs.makedirs(p, exist_ok=True)
+            pq.write_table(pa.Table.from_pandas(frame.reset_index(drop=True)),
+                           f"{p}/part-00000.parquet",
+                           row_group_size=rows_per_group,
+                           filesystem=fs)
+
+        write(train_df, train_path)
+        if val_df is not None and len(val_df):
+            write(val_df, val_path)
+            return train_path, val_path
+        return train_path, None
+
+    # -- fit (estimator.py:25 fit -> Transformer) ---------------------------
+
+    def fit(self, df) -> "TpuTransformer":
+        from .store import LocalStore
+        store = self.store
+        if store is None:
+            import tempfile
+            store = LocalStore(tempfile.mkdtemp(prefix="hvd_tpu_store_"))
+        run_id = self.run_id or \
+            f"run_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
+        train_path, val_path = self._write_parquet(df, store)
+        cfg = {
+            "model": self.model, "optimizer": self.optimizer,
+            "loss": self.loss, "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols, "batch_size": self.batch_size,
+            "epochs": self.epochs, "shuffle": self.shuffle,
+            "verbose": self.verbose, "seed": self.random_seed,
+            "store": store, "run_id": run_id,
+            "train_path": train_path, "val_path": val_path,
+            "platform": self.worker_platform,
+        }
+        try:
+            import pyspark
+            from pyspark import SparkContext
+            has_spark_ctx = SparkContext._active_spark_context is not None
+        except ImportError:
+            has_spark_ctx = False
+        if has_spark_ctx and _is_spark_df(df):
+            from .. import spark_integration
+            results = spark_integration.run(
+                _estimator_train_fn, args=(cfg,), num_proc=self.num_proc)
+        else:
+            from .. import runner
+            results = runner.run(_estimator_train_fn, args=(cfg,),
+                                 np=self.num_proc)
+        self.history = results[0]
+        ckpt = store.read_obj(store.get_checkpoint_path(run_id))
+        return TpuTransformer(model=self.model, params=ckpt["params"],
+                              feature_cols=self.feature_cols,
+                              label_cols=self.label_cols,
+                              history=ckpt["history"], run_id=run_id,
+                              store=store)
+
+
+class TpuTransformer:
+    """Trained-model Transformer (spark/common/estimator.py
+    HorovodModel.transform analog): adds ``<label>__output`` prediction
+    columns.  Accepts a pandas or pyspark DataFrame; pyspark input is
+    predicted on the driver and returned as a pyspark DataFrame."""
+
+    def __init__(self, model, params, feature_cols, label_cols,
+                 history=None, run_id=None, store=None):
+        self.model = model
+        self.params = params
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.history = history or []
+        self.run_id = run_id
+        self.store = store
+
+    def output_cols(self) -> List[str]:
+        return [f"{c}__output" for c in self.label_cols]
+
+    def predict(self, X):
+        import jax.numpy as jnp
+        return self.model.apply(self.params, jnp.asarray(X))
+
+    def transform(self, df):
+        import numpy as np
+        spark_session = None
+        if _is_spark_df(df):
+            spark_session = df.sparkSession
+            pdf = df.toPandas()
+        else:
+            import pandas as pd
+            pdf = df if isinstance(df, pd.DataFrame) else pd.DataFrame(df)
+            pdf = pdf.copy()
+        cols = {c: list(pdf[c]) for c in self.feature_cols}
+        X = _columns_to_array(cols, self.feature_cols)
+        pred = np.asarray(self.predict(X))
+        outs = self.output_cols()
+        if len(outs) == 1:
+            pdf[outs[0]] = list(pred) if pred.ndim > 1 else pred
+        else:
+            for i, c in enumerate(outs):
+                pdf[c] = pred[..., i]
+        if spark_session is not None:
+            return spark_session.createDataFrame(pdf)
+        return pdf
+
+    # -- persistence (Spark ML write().save analog) -------------------------
+
+    def save(self, path: str) -> None:
+        import cloudpickle
+        from .store import FilesystemStore
+        st = self.store or FilesystemStore(path.rsplit("/", 1)[0] or ".")
+        st.write_bytes(path, cloudpickle.dumps({
+            "model": self.model, "params": self.params,
+            "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols, "history": self.history,
+        }))
+
+    @staticmethod
+    def load(path: str) -> "TpuTransformer":
+        import cloudpickle
+        from .store import FilesystemStore
+        st = FilesystemStore(path.rsplit("/", 1)[0] or ".")
+        d = cloudpickle.loads(st.read_bytes(path))
+        return TpuTransformer(**d)

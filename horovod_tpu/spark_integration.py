@@ -8,9 +8,8 @@ DataFrame→Parquet via a Store, petastorm readers, returns a Transformer).
 TPU build scope: the ``run(fn, ...)`` entry point with the same rendezvous
 flow (each Spark task becomes one rank; the driver hosts the HTTP
 rendezvous KV store the tasks read, exactly like the CLI launcher).  The
-full Estimator/Store/petastorm stack is out of scope for a TPU-first build
-— TPU input pipelines are Grain/array_record-shaped, not petastorm-shaped
-(SURVEY.md §7 step 9) — so ``HorovodTpuEstimator`` raises with guidance.
+Estimator/Store layer lives in ``horovod_tpu.spark`` (estimator.py,
+store.py) — Parquet via pyarrow instead of petastorm.
 
 PySpark is not a dependency of the core: everything gates on ``import
 pyspark`` at call time.
@@ -121,15 +120,10 @@ def run(fn: Callable,
     return [r for _, r in sorted(results)]
 
 
-class HorovodTpuEstimator:
-    """Placeholder for the Spark ML Estimator layer
-    (spark/common/estimator.py).  The petastorm/Parquet Store pipeline is
-    GPU-era plumbing; on TPU use a Grain/array_record input pipeline with
-    ``spark_integration.run`` instead."""
-
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "HorovodTpuEstimator is not implemented: the reference's "
-            "petastorm-based Estimator does not map to TPU input pipelines. "
-            "Use horovod_tpu.spark_integration.run(train_fn, ...) with a "
-            "Grain/array_record dataset, or the Ray executor.")
+def __getattr__(name):
+    # Lazy re-export: the Estimator layer lives in horovod_tpu.spark
+    # (spark/estimator.py), but the old import path keeps working.
+    if name in ("HorovodTpuEstimator", "TpuTransformer"):
+        from .spark import estimator as _est
+        return getattr(_est, name)
+    raise AttributeError(name)
