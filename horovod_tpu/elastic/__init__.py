@@ -35,6 +35,7 @@ from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils import get_logger
 from .. import config as _config
 from .state import State, ObjectState, ArrayState, TpuState  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401
 from .driver import ElasticDriver  # noqa: F401
 from .discovery import (  # noqa: F401
     HostDiscovery, HostDiscoveryScript, FixedHostDiscovery, HostManager)
@@ -145,6 +146,7 @@ def _refresh_world_from_rendezvous() -> None:
     last_version = int(os.environ.get("HVD_TPU_WORLD_VERSION", "0"))
     deadline = time.time() + float(
         os.environ.get(_config.HOROVOD_ELASTIC_TIMEOUT, "600"))
+    scaled_out_since = None
     while time.time() < deadline:
         try:
             world_raw = client.get("rendezvous", "world")
@@ -152,27 +154,65 @@ def _refresh_world_from_rendezvous() -> None:
             if world.get("version", 0) > last_version:
                 raw = client.get("rendezvous",
                                  f"slot/{hostname}/{local_rank}")
-                if raw:
-                    rec = json.loads(raw)
-                    if rec.get("version", 0) == world["version"]:
-                        os.environ[_config.HOROVOD_RANK] = str(rec["rank"])
-                        os.environ[_config.HOROVOD_SIZE] = str(rec["size"])
-                        os.environ[_config.HOROVOD_LOCAL_RANK] = \
-                            str(rec["local_rank"])
-                        os.environ[_config.HOROVOD_LOCAL_SIZE] = \
-                            str(rec["local_size"])
-                        os.environ[_config.HOROVOD_CROSS_RANK] = \
-                            str(rec["cross_rank"])
-                        os.environ[_config.HOROVOD_CROSS_SIZE] = \
-                            str(rec["cross_size"])
-                        os.environ["HVD_TPU_WORLD_VERSION"] = \
-                            str(rec["version"])
-                        return
+                rec = json.loads(raw) if raw else {}
+                if rec.get("version", 0) != world["version"]:
+                    # A new world exists and this (host, local_rank) has no
+                    # slot in it: we were scaled out.  Exit GRACEFULLY —
+                    # the driver records a decommission, not a failure, and
+                    # an abrupt death here would FATAL the survivors'
+                    # jax.distributed clients.  Short grace window in case
+                    # the driver is mid-publication of yet another world.
+                    if scaled_out_since is None:
+                        scaled_out_since = time.time()
+                    elif time.time() - scaled_out_since > 5.0:
+                        get_logger().info(
+                            "elastic: no slot for (%s, %s) in world v%s — "
+                            "scaled out, exiting", hostname, local_rank,
+                            world["version"])
+                        raise SystemExit(0)
+                else:
+                    os.environ[_config.HOROVOD_RANK] = str(rec["rank"])
+                    os.environ[_config.HOROVOD_SIZE] = str(rec["size"])
+                    os.environ[_config.HOROVOD_LOCAL_RANK] = \
+                        str(rec["local_rank"])
+                    os.environ[_config.HOROVOD_LOCAL_SIZE] = \
+                        str(rec["local_size"])
+                    os.environ[_config.HOROVOD_CROSS_RANK] = \
+                        str(rec["cross_rank"])
+                    os.environ[_config.HOROVOD_CROSS_SIZE] = \
+                        str(rec["cross_size"])
+                    os.environ["HVD_TPU_WORLD_VERSION"] = \
+                        str(rec["version"])
+                    return
         except Exception as e:
             get_logger().debug("rendezvous refresh retry: %s", e)
         time.sleep(0.5)
     raise HorovodInternalError(
         "timed out waiting for a slot assignment after reset")
+
+
+def coordinator_port_for(base: int, world_version: int,
+                         reset_count: int = 0) -> int:
+    """Coordinator port for a world incarnation: a fresh jax.distributed
+    coordination service per (world, same-world reset) — the TF
+    coordination service rejects a task reconnecting to a live service
+    with a new incarnation id, so every reshape/recovery must bind a new
+    port.  All ranks derive the same value from the same generation; the
+    SAME formula feeds freshly spawned workers (launch_support,
+    ray_elastic) and surviving workers (_reset)."""
+    return int(base) + (int(world_version) * 16 + int(reset_count)) % 2000
+
+
+def _coordinator_for_gen(gen: str) -> Optional[str]:
+    """Coordinator address for a negotiation generation "w.c" (see
+    coordinator_port_for)."""
+    base = os.environ.get("HVD_TPU_COORD_BASE")
+    cur = os.environ.get("HVD_TPU_COORDINATOR")
+    if not base or not cur:
+        return None
+    host = cur.rsplit(":", 1)[0]
+    w, _, c = gen.partition(".")
+    return f"{host}:{coordinator_port_for(int(base), int(w), int(c or 0))}"
 
 
 def _reset(refresh_world: bool = True) -> None:
@@ -195,6 +235,10 @@ def _reset(refresh_world: bool = True) -> None:
             # identically.
             os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
                 f"{os.environ.get('HVD_TPU_WORLD_VERSION', '0')}.0"
+            coord = _coordinator_for_gen(
+                os.environ["HVD_TPU_NEGOTIATION_GEN"])
+            if coord:
+                os.environ["HVD_TPU_COORDINATOR"] = coord
         else:
             # Same world, in-place recovery: every rank received the same
             # collective-failure verdict and resets together — bump the
@@ -204,6 +248,10 @@ def _reset(refresh_world: bool = True) -> None:
             w, _, c = cur.partition(".")
             os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
                 f"{w}.{int(c or 0) + 1}"
+            coord = _coordinator_for_gen(
+                os.environ["HVD_TPU_NEGOTIATION_GEN"])
+            if coord:
+                os.environ["HVD_TPU_COORDINATOR"] = coord
         import jax
         try:
             from jax._src import distributed as _jdist
@@ -255,10 +303,51 @@ def run(func):
         skip_sync = False
         reset_required = False
         refresh_world = True
+        reset_failures = 0
+        no_progress_failures = 0
         try:
             while True:
+                if reset_required and not refresh_world:
+                    # In-place recovery assumes UNCHANGED membership; a
+                    # pending host update (e.g. the failure was a peer
+                    # being decommissioned) means the world DID change and
+                    # re-initializing into the stale env would hang — take
+                    # the refresh path instead.
+                    try:
+                        state.check_host_updates()
+                    except HostsUpdatedInterrupt as e:
+                        skip_sync = e.skip_sync
+                        refresh_world = True
                 if reset_required:
-                    _reset(refresh_world=refresh_world)
+                    try:
+                        _reset(refresh_world=refresh_world)
+                    except Exception as e:
+                        # Re-init can fail transiently while the new world
+                        # is still assembling (jax.distributed barrier or
+                        # gloo context timeouts): retry the reset, letting
+                        # the top-of-loop host-update check upgrade to a
+                        # world refresh when membership changed again.
+                        import jax as _jax
+                        if not isinstance(e, (HorovodInternalError,
+                                              _jax.errors.JaxRuntimeError)):
+                            raise
+                        reset_failures += 1
+                        if reset_failures >= 6:
+                            # A dead launcher/rendezvous makes every reset
+                            # time out; re-raise so the worker terminates
+                            # instead of looping timeout/warn forever.
+                            raise
+                        get_logger().warning(
+                            "elastic: reset failed (%s); retrying "
+                            "(%d/5)", e, reset_failures)
+                        if reset_failures >= 3:
+                            # Same-world retries keep failing: assume the
+                            # world DID change under us and wait for a new
+                            # version.
+                            refresh_world = True
+                        time.sleep(1.0)
+                        continue
+                    reset_failures = 0
                     # Restore AFTER the backend reset: the in-memory commit
                     # holds host (numpy) copies, so restore re-materializes
                     # arrays on the NEW backend.  (Restoring before the
@@ -268,13 +357,26 @@ def run(func):
                     # immediately before raising.
                     state.restore()
                     state.on_reset()
+                seq_before = getattr(state, "_commit_seq", 0)
                 try:
                     if not skip_sync:
                         state.sync()
                     return func(state, *args, **kwargs)
-                except HorovodInternalError:
+                except HorovodInternalError as e:
+                    # Progress bound: a DETERMINISTIC failure (e.g. a
+                    # device OOM surfacing through the collective error
+                    # mapping) would otherwise restore-and-retry forever on
+                    # the in-place path, invisible to --reset-limit.  Any
+                    # committed progress between failures resets the count.
+                    if getattr(state, "_commit_seq", 0) > seq_before:
+                        no_progress_failures = 1
+                    else:
+                        no_progress_failures += 1
+                    if no_progress_failures > 5:
+                        raise
                     get_logger().info(
-                        "elastic: collective failure — restoring last commit")
+                        "elastic: collective failure (%s) — restoring last "
+                        "commit", e)
                     skip_sync = False
                     refresh_world = False  # membership unchanged
                 except HostsUpdatedInterrupt as e:

@@ -39,6 +39,9 @@ from .discovery import HostDiscovery, HostDiscoveryScript, HostManager
 from .registration import WorkerStateRegistry
 
 DISCOVER_INTERVAL_S = 1.0
+# How long a scaled-out worker gets to exit on its own before SIGTERM.
+DECOMMISSION_GRACE_S = float(os.environ.get(
+    "HVD_TPU_DECOMMISSION_GRACE_S", "30"))
 
 
 class Worker:
@@ -48,6 +51,11 @@ class Worker:
         self.version = version  # refreshed on every world reactivation
         self.thread: Optional[threading.Thread] = None
         self.terminate_event = threading.Event()
+        # Graceful decommission (scale-down): the slot fell out of the new
+        # world, so the exit is not a failure and must not blacklist the
+        # (still healthy) host.
+        self.decommissioned = False
+        self.decommission_timer: Optional[threading.Timer] = None
 
 
 class ElasticDriver:
@@ -205,12 +213,46 @@ class ElasticDriver:
                 "rendezvous", "world",
                 json.dumps({"version": self._world_version,
                             "size": len(new_assignments)}).encode())
+            new_keys = {(s.hostname, s.local_rank)
+                        for s in new_assignments}
+            for key, w in list(self._workers.items()):
+                if key not in new_keys and not w.decommissioned:
+                    # Slot-granular scale-DOWN: the host survived but lost
+                    # slots (e.g. localhost:3 -> localhost:2).  The worker
+                    # is NOT killed here: an abrupt death while peers'
+                    # jax.distributed clients are live FATALs the
+                    # survivors (TF coordination service error polling).
+                    # Instead it discovers during re-rendezvous that no
+                    # slot record carries the new world version and exits
+                    # 0 on its own (elastic/__init__.py
+                    # _refresh_world_from_rendezvous); SIGTERM is only the
+                    # grace-period fallback.  No failure record, no
+                    # blacklist (elastic_common.py:305 shrink semantics).
+                    w.decommissioned = True
+                    w.decommission_timer = threading.Timer(
+                        DECOMMISSION_GRACE_S, w.terminate_event.set)
+                    w.decommission_timer.start()
             for slot in new_assignments:
                 key = (slot.hostname, slot.local_rank)
-                if key in self._workers:
-                    # Surviving worker adopted into the new world: a later
-                    # failure is a fresh event, not a stale one.
-                    self._workers[key].version = self._world_version
+                w = self._workers.get(key)
+                if w is not None and w.decommissioned and \
+                        (w.thread is None or not w.thread.is_alive() or
+                         w.terminate_event.is_set()):
+                    # Discovery flapped back but the decommissioned worker
+                    # is already gone (or past the point of no return):
+                    # replace it.  (Its deregister pops only its own
+                    # registration, so the overwrite is safe.)
+                    w = None
+                if w is not None:
+                    # Surviving worker adopted into the new world: clear
+                    # any in-flight decommission (a shrink-then-grow flap
+                    # must not SIGTERM a now-valid worker) and make later
+                    # failures fresh events, not stale ones.
+                    if w.decommission_timer is not None:
+                        w.decommission_timer.cancel()
+                        w.decommission_timer = None
+                    w.decommissioned = False
+                    w.version = self._world_version
                 else:
                     self._launch_worker(slot)
 
@@ -235,9 +277,20 @@ class ElasticDriver:
         def run():
             ret = self._worker_cmd_fn(slot, worker.terminate_event,
                                       spawn_version)
-            if self._shutdown.is_set():
+            key = (slot.hostname, slot.local_rank)
+
+            def deregister():
                 with self._lock:
-                    self._workers.pop((slot.hostname, slot.local_rank), None)
+                    # Pop only OUR registration: the slot may have been
+                    # re-launched (scale down then up) while this thread
+                    # was still reaping the old process.
+                    if self._workers.get(key) is worker:
+                        self._workers.pop(key, None)
+
+            if self._shutdown.is_set() or worker.decommissioned:
+                # Shutdown or graceful scale-down: the nonzero exit of a
+                # terminated process is not a training failure.
+                deregister()
                 return
             # Record BEFORE deregistering so join() never sees an idle gap
             # between worker exit and the resume request.
@@ -247,8 +300,7 @@ class ElasticDriver:
             else:
                 self.registry.record_failure(slot.hostname, slot.local_rank,
                                              worker.version)
-            with self._lock:
-                self._workers.pop((slot.hostname, slot.local_rank), None)
+            deregister()
 
         worker.thread = threading.Thread(target=run, daemon=True,
                                          name=f"hvd-worker-{slot.rank}")

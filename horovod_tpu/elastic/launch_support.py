@@ -20,6 +20,15 @@ from ..runner import safe_shell_exec
 from ..runner.launch import env_from_args, _is_local, _ssh_command
 
 
+def _coord_base() -> int:
+    return int(os.environ.get("HVD_TPU_COORD_PORT", 29400))
+
+
+def _coord_port(world_version: int) -> int:
+    from . import coordinator_port_for
+    return coordinator_port_for(_coord_base(), world_version)
+
+
 def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
@@ -46,8 +55,13 @@ def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
             # baselines here so pre-spawn updates are not replayed and
             # post-spawn ones are never missed.
             "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
+            # Per-incarnation coordinator port (elastic/__init__.py
+            # coordinator_port_for): every world reshape gets a FRESH
+            # jax.distributed coordination service — reusing a live one
+            # rejects reconnecting tasks ("different incarnation").
+            "HVD_TPU_COORD_BASE": str(_coord_base()),
             "HVD_TPU_COORDINATOR":
-                f"{addr}:{int(os.environ.get('HVD_TPU_COORD_PORT', 29400))}",
+                f"{addr}:{_coord_port(world_version)}",
         })
         prefix = f"[{slot.rank}]<stdout>:"
         cmd = args.command if _is_local(slot.hostname) else \

@@ -32,6 +32,7 @@ class State:
     def __init__(self, **kwargs):
         self._reset_callbacks: List[Callable] = []
         self._host_messages = None  # set by the notification manager
+        self._commit_seq = 0  # progress marker for the elastic retry bound
 
     def register_reset_callbacks(self, callbacks) -> None:
         """Callbacks invoked after world reset (re-jit, rebuild data sharding
@@ -51,6 +52,7 @@ class State:
         """Checkpoint to memory and check for host changes
         (common/elastic.py State.commit)."""
         self.save()
+        self._commit_seq = getattr(self, "_commit_seq", 0) + 1
         self.check_host_updates()
 
     def check_host_updates(self) -> None:

@@ -266,12 +266,22 @@ class EagerEngine:
                                   postscale=postscale, ps_id=ps_id,
                                   timeline=tl)
                 mesh = self._multiproc_mesh()
-                global_ts = [self._to_global(t) for t in tensors]
-                outs = self._stacked_run(kind, body, global_ts, static_params,
-                                         mesh)
-                if not isinstance(outs, (tuple, list)):
-                    outs = [outs]
-                return [self._from_global(o) for o in outs]
+                try:
+                    global_ts = [self._to_global(t) for t in tensors]
+                    outs = self._stacked_run(kind, body, global_ts,
+                                             static_params, mesh)
+                    if not isinstance(outs, (tuple, list)):
+                        outs = [outs]
+                    return [self._from_global(o) for o in outs]
+                except jax.errors.JaxRuntimeError as e:
+                    # A failed compiled collective (peer died, gloo/ICI
+                    # context torn down mid-run) is the reference's
+                    # HorovodInternalError contract (exceptions.py:18) —
+                    # elastic restores the last commit and re-initializes.
+                    from ..exceptions import HorovodInternalError
+                    raise HorovodInternalError(
+                        f"collective {label!r} failed on the device "
+                        f"runtime: {e}") from e
             finally:
                 if tl is not None:
                     tl.end(label, kind.upper())
