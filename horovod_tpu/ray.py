@@ -1,0 +1,12 @@
+"""horovod_tpu.ray — Ray cluster integration namespace.
+
+Reference surface (horovod/ray/__init__.py): RayExecutor (static worlds,
+ray/runner.py:45) and the elastic executor + discovery
+(ray/elastic_v2.py).  Both gate on ``import ray`` at call time — the core
+framework does not depend on it.
+"""
+
+from .ray_integration import RayExecutor  # noqa: F401
+from .ray_elastic import (  # noqa: F401
+    ElasticRayExecutor, RayHostDiscovery,
+)

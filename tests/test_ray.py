@@ -1,0 +1,167 @@
+"""Ray integration: discovery mapping and the elastic executor wiring,
+tested against a FAKE cluster (reference pattern: test/single/test_ray*.py
+run against a local ray; ray is absent from this image, so the node-state
+API is stubbed and the actor-spawn layer is injected)."""
+
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from horovod_tpu.elastic.discovery import HostDiscovery
+
+
+# ---------------------------------------------------------------------------
+# RayHostDiscovery
+# ---------------------------------------------------------------------------
+
+def _fake_ray_module(nodes):
+    mod = types.ModuleType("ray")
+    mod.nodes = lambda: nodes
+    return mod
+
+
+def test_ray_host_discovery_cpu(monkeypatch):
+    from horovod_tpu.ray_elastic import RayHostDiscovery
+    monkeypatch.setitem(sys.modules, "ray", _fake_ray_module([
+        {"Alive": True, "NodeManagerHostname": "n1",
+         "Resources": {"CPU": 8.0}},
+        {"Alive": True, "NodeManagerHostname": "n2",
+         "Resources": {"CPU": 3.0}},
+        {"Alive": False, "NodeManagerHostname": "dead",
+         "Resources": {"CPU": 64.0}},
+    ]))
+    disc = RayHostDiscovery(cpus_per_worker=2)
+    assert disc.find_available_hosts_and_slots() == {"n1": 4, "n2": 1}
+
+
+def test_ray_host_discovery_gpu_and_tpu(monkeypatch):
+    from horovod_tpu.ray_elastic import RayHostDiscovery
+    nodes = [{"Alive": True, "NodeManagerHostname": "n1",
+              "Resources": {"CPU": 16.0, "GPU": 4.0, "TPU": 8.0}}]
+    monkeypatch.setitem(sys.modules, "ray", _fake_ray_module(nodes))
+    assert RayHostDiscovery(use_gpu=True, gpus_per_worker=2) \
+        .find_available_hosts_and_slots() == {"n1": 2}
+    assert RayHostDiscovery(tpu_per_worker=4) \
+        .find_available_hosts_and_slots() == {"n1": 2}
+    # Zero-slot hosts are omitted entirely.
+    assert RayHostDiscovery(use_gpu=True, gpus_per_worker=8) \
+        .find_available_hosts_and_slots() == {}
+
+
+# ---------------------------------------------------------------------------
+# ElasticRayExecutor against a fake spawn layer
+# ---------------------------------------------------------------------------
+
+class MutableDiscovery(HostDiscovery):
+    def __init__(self, hosts):
+        self.hosts = dict(hosts)
+        self.lock = threading.Lock()
+
+    def find_available_hosts_and_slots(self):
+        with self.lock:
+            return dict(self.hosts)
+
+    def set(self, hosts):
+        with self.lock:
+            self.hosts = dict(hosts)
+
+
+class FakeHandle:
+    """Stands in for a Ray actor: completes when the test fires ``finish``;
+    reports the CURRENT driver world version (emulating the in-worker world
+    refresh a survivor performs on reset)."""
+
+    def __init__(self, entry, env, driver_getter, finish, killed):
+        self.entry = entry
+        self.env = env
+        self.driver_getter = driver_getter
+        self.finish = finish
+        self.killed_list = killed
+        self.killed = False
+
+    def wait(self, timeout):
+        if self.killed:
+            return True
+        return self.finish.wait(timeout)
+
+    def result(self):
+        if self.killed:
+            return 143, None
+        user_fn = self.entry.args[0]  # functools.partial(_worker_entry, fn..)
+        ver = self.driver_getter().world_version
+        return 0, (ver, int(self.env["HOROVOD_RANK"]),
+                   int(self.env["HOROVOD_SIZE"]), user_fn())
+
+    def kill(self):
+        self.killed = True
+        self.killed_list.append(int(self.env["HOROVOD_RANK"]))
+
+
+def _make_executor(disc, min_w, max_w, finish, killed, spawned):
+    from horovod_tpu.ray_elastic import ElasticRayExecutor
+    holder = {}
+
+    def spawn(entry, args, kwargs, env, slot):
+        h = FakeHandle(entry, env, lambda: holder["ex"]._driver,
+                       finish, killed)
+        spawned.append(env)
+        return h
+
+    ex = ElasticRayExecutor(min_workers=min_w, max_workers=max_w,
+                            override_discovery=disc, spawn_fn=spawn,
+                            elastic_timeout=30)
+    holder["ex"] = ex
+    return ex
+
+
+def test_elastic_ray_executor_static_world():
+    disc = MutableDiscovery({"h1": 2})
+    finish, killed, spawned = threading.Event(), [], []
+    ex = _make_executor(disc, 2, 2, finish, killed, spawned)
+    finish.set()  # workers complete immediately
+    out = ex.run(lambda: "ok")
+    ex.shutdown()
+    assert out == ["ok", "ok"]
+    ranks = sorted(int(e["HOROVOD_RANK"]) for e in spawned)
+    assert ranks == [0, 1]
+    for e in spawned:
+        assert e["HOROVOD_ELASTIC"] == "1"
+        assert e["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+        assert int(e["HOROVOD_GLOO_RENDEZVOUS_PORT"]) > 0
+    assert killed == []
+
+
+def test_elastic_ray_executor_scale_down_decommissions(monkeypatch):
+    """Autoscaler shrink (h1: 3 -> 2): the slot-2 worker is killed and NOT
+    recorded as a failure (no blacklist, run succeeds); survivors' results
+    form the final world (elastic_v2 shrink semantics).  The fake worker
+    has no graceful-exit path, so shorten the decommission grace window
+    the driver gives real workers before the SIGTERM fallback."""
+    from horovod_tpu.elastic import driver as driver_mod
+    monkeypatch.setattr(driver_mod, "DECOMMISSION_GRACE_S", 0.3)
+    disc = MutableDiscovery({"h1": 3})
+    finish, killed, spawned = threading.Event(), [], []
+    ex = _make_executor(disc, 2, 3, finish, killed, spawned)
+    result_box = {}
+
+    def run():
+        result_box["out"] = ex.run(lambda: "ok")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 15
+    while len(spawned) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(spawned) == 3
+    disc.set({"h1": 2})  # autoscaler removed a slot
+    while not killed and time.time() < deadline:
+        time.sleep(0.05)
+    assert killed == [2], killed
+    finish.set()  # survivors complete in the reshaped world
+    t.join(timeout=30)
+    assert not t.is_alive()
+    ex.shutdown()
+    assert result_box["out"] == ["ok", "ok"]
