@@ -212,6 +212,51 @@ grouped_allreduce_ = grouped_allreduce
 grouped_allreduce_async_ = grouped_allreduce_async
 
 
+def _fused_allreduce(tensors: Sequence, op,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set: ProcessSet = global_process_set) -> List:
+    """Eager fused allreduce over one FLAT fusion buffer: host-side pack
+    (MemcpyInFusionBuffer, operations.cc:519), a single dispatched
+    collective for the whole bucket, then device-side slice+reshape
+    (MemcpyOutFusionBuffer).  One global-array assembly instead of one per
+    tensor — the reference's tensor-fusion data path, which is where the
+    eager dispatch time went (one device_put per leaf).
+
+    All tensors must share one dtype (the fusion planner only buckets
+    same-dtype entries, csrc PlanFusion / controller.cc:901)."""
+    rop = ReduceOp(op)
+    axis = _axis()
+    members = _members(process_set)
+    eng = _engine()
+    np_ts = [np.asarray(t) for t in tensors]
+    dtype = np_ts[0].dtype
+    shapes = [t.shape for t in np_ts]
+    sizes = [int(t.size) for t in np_ts]
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    flat = np.empty(int(offsets[-1]), dtype=dtype)
+    for t, a, b in zip(np_ts, offsets[:-1], offsets[1:]):
+        flat[a:b] = t.ravel()
+
+    def body(x):
+        return C.allreduce(x, rop, axis_name=axis, members=members,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+
+    def single(ts):
+        x = C._apply_scale(ts[0], prescale_factor)
+        return [C._apply_scale(x, postscale_factor)]
+
+    out = eng.run("allreduce", body, [jnp.asarray(flat)],
+                  (int(rop), members, prescale_factor, postscale_factor),
+                  single, name=f"fusedbuf.{dtype}.{int(offsets[-1])}",
+                  op_id=int(rop), prescale=prescale_factor,
+                  postscale=postscale_factor,
+                  ps_id=process_set.process_set_id or 0)[0]
+    return [out[int(a):int(b)].reshape(s)
+            for a, b, s in zip(offsets[:-1], offsets[1:], shapes)]
+
+
 # ---------------------------------------------------------------------------
 # allgather
 # ---------------------------------------------------------------------------

@@ -112,6 +112,31 @@ def _identity_for(op: ReduceOp, dtype):
     raise ValueError(f"no identity for {op!r}")
 
 
+def _ring_reduce(x: jax.Array, axis_name: str, op_fn,
+                 groups=None) -> jax.Array:
+    """Exact elementwise reduction without a gather: rotate copies around
+    the (group) ring N-1 times, folding with ``op_fn`` — O(|x|) memory,
+    N-1 ICI hops.  The ring neighbor permutation is identical every hop, so
+    the loop stays a compact ``fori_loop`` (compiler-friendly control flow,
+    no O(N) program blowup)."""
+    if groups is None:
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    else:
+        n = len(groups[0])
+        perm = [(g[i], g[(i + 1) % n]) for g in groups for i in range(n)]
+    if n == 1:
+        return x
+
+    def body(_, carry):
+        acc, cur = carry
+        cur = lax.ppermute(cur, axis_name, perm)
+        return op_fn(acc, cur), cur
+
+    acc, _ = lax.fori_loop(0, n - 1, body, (x, x))
+    return acc
+
+
 def allreduce(x: jax.Array,
               op: ReduceOp = ReduceOp.AVERAGE,
               *,
@@ -143,9 +168,25 @@ def allreduce(x: jax.Array,
     elif op == ReduceOp.MAX:
         r = lax.pmax(masked, axis_name, axis_index_groups=groups)
     elif op == ReduceOp.PRODUCT:
-        # No pprod primitive: gather then row-reduce; XLA fuses the reduction.
-        g = lax.all_gather(masked, axis_name, axis_index_groups=groups, axis=0)
-        r = jnp.prod(g, axis=0).astype(x.dtype)
+        # No pprod primitive.  Ring-reduce via ppermute: N-1 hops each
+        # multiplying the neighbor's copy — O(|x|) memory and exact for
+        # every dtype (an all_gather lowering is O(N·|x|) and blows up for
+        # large gradient tensors at pod scale; log-exp psum is inexact).
+        # The ring fold order is rotated per rank, so float products can
+        # differ by ULPs across ranks; canonicalize by broadcasting one
+        # leader's fold (reduce+bcast semantics — every rank gets the
+        # bitwise-identical result, the allreduce contract).
+        r = _ring_reduce(masked, axis_name, jnp.multiply, groups=groups)
+        if jnp.issubdtype(r.dtype, jnp.floating) or \
+                jnp.issubdtype(r.dtype, jnp.complexfloating):
+            idx = lax.axis_index(axis_name)
+            if groups is None:
+                leaders = jnp.asarray(
+                    [members[0] if members is not None else 0], jnp.int32)
+            else:
+                leaders = jnp.asarray([g[0] for g in groups], jnp.int32)
+            canon = jnp.where(jnp.isin(idx, leaders), r, jnp.zeros_like(r))
+            r = lax.psum(canon, axis_name, axis_index_groups=groups)
     elif op == ReduceOp.ADASUM:
         from . import adasum as _adasum
         r = _adasum.adasum_allreduce(x, axis_name=axis_name, members=members)
@@ -270,18 +311,29 @@ def alltoall(x: jax.Array,
     if members is None:
         return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
                               axis_index_groups=groups, tiled=True)
-    # Subset path via full gather + static member selection + dynamic block
-    # slice at this slot's set-relative rank.
+    # Subset path: k-1 block rotations around the MEMBER ring (ppermute) —
+    # O(|x|) memory, one block per hop.  (The previous full-axis all_gather
+    # lowering was O(N·|x|), a blowup for large tensors at pod scale.)  At
+    # hop s, member g sends its block (g+s) mod k to member (g+s) mod k and
+    # receives block g from member (g-s) mod k; non-members are not in the
+    # permutation, so they send nothing and keep their input.
     mask, idx = _member_mask(members, axis_name)
     grank = _group_rank(members, idx)
     blk = x.shape[0] // n
-    stacked = lax.all_gather(x, axis_name, axis=0)            # [N, d0, ...]
-    sel = stacked[jnp.asarray(members, dtype=jnp.int32)]      # [k, d0, ...]
-    start = (jnp.zeros((sel.ndim,), jnp.int32)
-             .at[1].set((grank * blk).astype(jnp.int32)))
-    block = lax.dynamic_slice(sel, tuple(start),
-                              (n, blk) + x.shape[1:])         # [k, blk, ...]
-    out = block.reshape((-1,) + x.shape[1:])                  # [k*blk, ...]
+    k = len(members)
+    out0 = lax.dynamic_slice_in_dim(x, grank * blk, blk, axis=0)
+    parts = [out0]  # block from myself (hop 0)
+    for s in range(1, k):
+        perm = [(members[i], members[(i + s) % k]) for i in range(k)]
+        send_idx = ((grank + s) % k) * blk
+        send = lax.dynamic_slice_in_dim(x, send_idx, blk, axis=0)
+        parts.append(lax.ppermute(send, axis_name, perm))
+    # parts[s] = block received at hop s, i.e. from member (grank - s) mod k;
+    # reorder so row-block j comes from member j.
+    stacked = jnp.stack(parts)                                # [k, blk, ...]
+    src = (grank - jnp.arange(k)) % k                         # hop -> source
+    ordered = jnp.zeros_like(stacked).at[src].set(stacked)
+    out = ordered.reshape((-1,) + x.shape[1:])                # [k*blk, ...]
     return jnp.where(mask, out, x[:out.shape[0]]) if out.shape == x.shape \
         else out
 

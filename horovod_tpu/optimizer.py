@@ -159,11 +159,28 @@ def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
         reduced = list(leaves)
         t0 = _time.perf_counter()
         total_bytes = sum(e[2] for e in entries)
+        # True multi-process dispatch packs each bucket into ONE flat
+        # fusion buffer (single device transfer + single collective — the
+        # reference's fusion-buffer data path, operations.cc:519).
+        # Emulated mode keeps grouped dispatch: its tensors are per-rank
+        # stacks the flat packing would mangle, and it has no per-tensor
+        # assembly cost to amortize.
+        topo = _core._state.topology
+        use_fused = (topo is not None and topo.size > 1
+                     and not topo.emulated
+                     and compression is Compression.none)
         for bucket in buckets:
-            outs = _ops.grouped_allreduce(
-                [leaves[i] for i in bucket], op=op, compression=compression,
-                prescale_factor=prescale, postscale_factor=postscale,
-                process_set=process_set)
+            if use_fused:
+                outs = _ops._fused_allreduce(
+                    [leaves[i] for i in bucket], op=op,
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    process_set=process_set)
+            else:
+                outs = _ops.grouped_allreduce(
+                    [leaves[i] for i in bucket], op=op,
+                    compression=compression,
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    process_set=process_set)
             for i, o in zip(bucket, outs):
                 reduced[i] = o
         if pm is not None and pm.enabled and not pm.converged:

