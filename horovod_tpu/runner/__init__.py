@@ -39,17 +39,25 @@ def run(func: Callable,
     import cloudpickle
     from . import hosts as _hosts_mod
     from .launch import _is_local
-    if hosts:
-        remote = [h.hostname for h in _hosts_mod.parse_hosts(hosts)
-                  if not _is_local(h.hostname)]
-        if remote:
-            raise NotImplementedError(
-                f"horovod_tpu.run() currently gathers results through a "
-                f"local temp dir and cannot collect from remote hosts "
-                f"{remote}; use the horovodrun CLI with a shared filesystem "
-                f"instead")
     kwargs = kwargs or {}
-    workdir = tempfile.mkdtemp(prefix="hvd_tpu_run_")
+    has_remote = bool(hosts) and any(
+        not _is_local(h.hostname) for h in _hosts_mod.parse_hosts(hosts))
+    if has_remote:
+        # Remote workers cd into this cwd over ssh (launch._ssh_command),
+        # so a cwd-anchored workdir is readable exactly when the job's
+        # working tree is on a shared mount — the reference's assumption
+        # for shipping the pickled function.  /tmp is per-machine.
+        base = os.path.join(os.getcwd(), ".hvd_tpu_run")
+        os.makedirs(base, exist_ok=True)
+        workdir = tempfile.mkdtemp(prefix="run_", dir=base)
+        from ..utils import get_logger
+        get_logger().info(
+            "run(): remote hosts %s read the pickled function from %s — "
+            "the working tree must be a shared mount",
+            [h.hostname for h in _hosts_mod.parse_hosts(hosts)
+             if not _is_local(h.hostname)], workdir)
+    else:
+        workdir = tempfile.mkdtemp(prefix="hvd_tpu_run_")
     fn_path = os.path.join(workdir, "func.pkl")
     with open(fn_path, "wb") as f:
         cloudpickle.dump((func, args, kwargs), f)
@@ -58,15 +66,36 @@ def run(func: Callable,
     # function is serialized by reference when its module is importable):
     # ship the parent's full sys.path, not just its cwd.
     parent_path = [p for p in [os.getcwd()] + sys.path if p]
-    bootstrap = (
-        "import pickle, os, sys; "
-        f"sys.path[:0] = [p for p in {parent_path!r} if p not in sys.path]; "
-        f"fn, a, kw = pickle.load(open({fn_path!r}, 'rb')); "
-        "r = fn(*a, **kw); "
-        "rank = int(os.environ.get('HOROVOD_RANK', 0)); "
-        f"pickle.dump(r, open(os.path.join({workdir!r}, "
-        "f'result_{rank}.pkl'), 'wb'))"
-    )
+    # Results travel back through the launcher's rendezvous KV store
+    # (runner/__init__.py:95 reference contract) so REMOTE ranks work too;
+    # the temp-dir file is kept as a local-host fallback.  The function
+    # itself ships via a shared-filesystem path like the reference's
+    # cloudpickle-through-KV (remote hosts need the repo + workdir mounted).
+    bootstrap = f"""
+import pickle, os, sys, urllib.request
+sys.path[:0] = [p for p in {parent_path!r} if p not in sys.path]
+fn, a, kw = pickle.load(open({fn_path!r}, 'rb'))
+r = fn(*a, **kw)
+rank = int(os.environ.get('HOROVOD_RANK', 0))
+payload = pickle.dumps(r)
+sent = False
+try:
+    addr = os.environ['HOROVOD_GLOO_RENDEZVOUS_ADDR']
+    port = os.environ['HOROVOD_GLOO_RENDEZVOUS_PORT']
+    req = urllib.request.Request(
+        'http://%s:%s/runresults/%d' % (addr, port, rank),
+        data=payload, method='PUT')
+    urllib.request.urlopen(req, timeout=30).read()
+    sent = True
+except Exception as e:
+    print('result KV put failed: %r' % (e,), file=sys.stderr)
+try:
+    open(os.path.join({workdir!r}, 'result_%d.pkl' % rank), 'wb') \\
+        .write(payload)
+except OSError:
+    if not sent:
+        raise
+"""
     argv = ["-np", str(np)]
     if hosts:
         argv += ["-H", hosts]
@@ -80,11 +109,22 @@ def run(func: Callable,
         argv += ["--verbose"]
     argv += [sys.executable, "-c", bootstrap]
     parsed = parse_args(argv)
-    ret = _run_static(parsed)
+    captured = {}
+
+    def _capture(rendezvous):
+        # The dict object outlives the server shutdown.
+        captured["kv"] = rendezvous.httpd.cache
+
+    ret = _run_static(parsed, on_rendezvous=_capture)
     if ret != 0:
         raise RuntimeError(f"horovod_tpu.run failed with exit code {ret}")
+    kv_results = captured.get("kv", {}).get("runresults", {})
     results = []
     for rank in range(np):
+        raw = kv_results.get(str(rank))
+        if raw is not None:
+            results.append(pickle.loads(raw))
+            continue
         path = os.path.join(workdir, f"result_{rank}.pkl")
         with open(path, "rb") as f:
             results.append(pickle.load(f))

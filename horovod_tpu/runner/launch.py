@@ -290,8 +290,12 @@ def _ssh_command(slot: _hosts.SlotInfo, command: List[str],
     return ssh + [slot.hostname, remote]
 
 
-def _run_static(args) -> int:
-    """Static (fixed world) launch (launch.py:594 _run_static)."""
+def _run_static(args, on_rendezvous=None) -> int:
+    """Static (fixed world) launch (launch.py:594 _run_static).
+
+    ``on_rendezvous`` (internal): called with the live RendezvousServer
+    after init — runner.run() captures its KV cache to collect per-rank
+    results shipped back by workers (runner/__init__.py:95 contract)."""
     if args.hostfile:
         host_list = _hosts.parse_host_files(args.hostfile)
     elif args.hosts:
@@ -305,6 +309,8 @@ def _run_static(args) -> int:
     rendezvous = RendezvousServer(verbose=args.verbose)
     port = rendezvous.start()
     rendezvous.init(assignments)
+    if on_rendezvous is not None:
+        on_rendezvous(rendezvous)
     has_remote = any(not _is_local(h.hostname) for h in host_list)
     addr = socket.gethostbyname(socket.gethostname()) if has_remote \
         else "127.0.0.1"

@@ -272,3 +272,138 @@ print("RUN_API_OK")
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "RUN_API_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Mock-exec launcher tests (reference pattern: test/single/test_run.py:1197 —
+# command synthesis + env injection with execution stubbed; no ssh/pyspark
+# in this image)
+# ---------------------------------------------------------------------------
+
+def test_remote_ssh_command_synthesis(monkeypatch):
+    """-H with a remote host: workers launch through ssh with the HOROVOD_*
+    env exported on the remote command line (gloo_run.py get_remote_command
+    analog)."""
+    from horovod_tpu.runner import launch as launch_mod
+    calls = []
+
+    def fake_execute(cmd, env=None, **kwargs):
+        calls.append((cmd, env))
+        return 0
+
+    monkeypatch.setattr(launch_mod.safe_shell_exec, "execute", fake_execute)
+    args = launch_mod.parse_args(
+        ["-np", "2", "-H", "remotebox:2", "-p", "2222",
+         "python", "train.py"])
+    assert launch_mod._run_static(args) == 0
+    assert len(calls) == 2
+    for i, (cmd, env) in enumerate(sorted(calls, key=lambda c:
+                                          c[1]["HOROVOD_RANK"])):
+        assert cmd[0] == "ssh" and "remotebox" in cmd
+        assert "-p" in cmd and "2222" in cmd
+        remote_line = cmd[-1]
+        assert f"HOROVOD_RANK={i}" in remote_line
+        assert "HOROVOD_SIZE=2" in remote_line
+        assert "HOROVOD_GLOO_RENDEZVOUS_ADDR=" in remote_line
+        assert "python train.py" in remote_line
+        assert env["HOROVOD_HOSTNAME"] == "remotebox"
+
+
+def test_run_api_prefers_kv_results(monkeypatch):
+    """runner.run(): per-rank results ship back through the rendezvous KV
+    (runner/__init__.py:95 contract) — the temp-dir file is only a
+    fallback, so remote ranks work.  Spies on the KV cache to prove the
+    results really traveled through it (the fallback alone would make the
+    output assertion pass)."""
+    import horovod_tpu.runner as runner_mod
+
+    orig = runner_mod._run_static
+    seen = {}
+
+    def spy(args, on_rendezvous=None):
+        def cap(rdv):
+            seen["kv"] = rdv.httpd.cache
+            if on_rendezvous is not None:
+                on_rendezvous(rdv)
+        return orig(args, on_rendezvous=cap)
+
+    monkeypatch.setattr(runner_mod, "_run_static", spy)
+    out = runner_mod.run(lambda: int(os.environ["HOROVOD_RANK"]) * 10, np=2)
+    assert out == [0, 10]
+    assert set(seen["kv"].get("runresults", {})) == {"0", "1"}
+
+
+def test_spark_run_env_injection_mocked(monkeypatch):
+    """spark_integration.run with a FAKE pyspark: barrier tasks get the
+    rendezvous env and per-rank results come back ordered
+    (spark/runner.py:200 contract; local-Spark test pattern
+    test/utils/spark_common.py:289)."""
+    import sys as _sys
+    import types
+
+    captured_envs = {}
+
+    class FakeBarrierCtx:
+        def __init__(self, idx):
+            self._idx = idx
+
+        def partitionId(self):
+            return self._idx
+
+    class FakeRDD:
+        def __init__(self, n):
+            self.n = n
+
+        def barrier(self):
+            return self
+
+        def mapPartitions(self, fn):
+            self._fn = fn
+            return self
+
+        def collect(self):
+            results = []
+            base_env = dict(os.environ)
+            for i in range(self.n):
+                fake_pyspark.BarrierTaskContext._current = FakeBarrierCtx(i)
+                os.environ.clear()
+                os.environ.update(base_env)
+                results.extend(self._fn(iter([i])))
+                captured_envs[i] = {
+                    k: v for k, v in os.environ.items()
+                    if k.startswith(("HOROVOD_", "HVD_TPU_"))}
+            os.environ.clear()
+            os.environ.update(base_env)
+            return results
+
+    class FakeSC:
+        defaultParallelism = 2
+
+        def parallelize(self, rng, n):
+            return FakeRDD(n)
+
+    fake_pyspark = types.ModuleType("pyspark")
+    fake_pyspark.SparkContext = types.SimpleNamespace(
+        _active_spark_context=FakeSC())
+
+    class _BTC:
+        _current = None
+
+        @classmethod
+        def get(cls):
+            return cls._current
+
+    fake_pyspark.BarrierTaskContext = _BTC
+    monkeypatch.setitem(_sys.modules, "pyspark", fake_pyspark)
+
+    from horovod_tpu import spark_integration
+    out = spark_integration.run(
+        lambda tag: f"{tag}-{os.environ['HOROVOD_RANK']}", args=("r",),
+        num_proc=2)
+    assert out == ["r-0", "r-1"]
+    for i in range(2):
+        env = captured_envs[i]
+        assert env["HOROVOD_RANK"] == str(i)
+        assert env["HOROVOD_SIZE"] == "2"
+        assert env["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+        assert "HVD_TPU_COORDINATOR" in env
