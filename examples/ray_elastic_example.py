@@ -1,0 +1,66 @@
+"""Elastic training on a Ray cluster.
+
+Reference analog: examples/ray/ray_elastic.py (elastic_v2 executor).
+
+The Ray autoscaler adding/removing nodes drives elastic scale-up/down:
+RayHostDiscovery turns alive-node resources into the host:slots view the
+ElasticDriver consumes, and each assigned slot runs as a Ray actor.
+
+Requires a running Ray cluster (`ray.init(...)` first)::
+
+    python examples/ray_elastic_example.py --min-workers 1 --max-workers 4
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def train_fn():
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    state = hvd.elastic.TpuState(params={"w": jnp.zeros((4,))}, step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < 50:
+            g = hvd.allreduce(jnp.ones((4,)), op=hvd.Average, name="g")
+            state.params = {"w": state.params["w"] + g}
+            state.step += 1
+            state.commit()
+        return float(state.params["w"][0])
+
+    return train(state)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--max-workers", type=int, default=4)
+    p.add_argument("--cpus-per-worker", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import ray
+
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    if not ray.is_initialized():
+        ray.init()
+    executor = ElasticRayExecutor(
+        min_workers=args.min_workers, max_workers=args.max_workers,
+        cpus_per_worker=args.cpus_per_worker)
+    executor.start()
+    try:
+        results = executor.run(train_fn)
+        print("per-rank results:", results)
+    finally:
+        executor.shutdown()
+
+
+if __name__ == "__main__":
+    main()

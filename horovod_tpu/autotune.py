@@ -37,7 +37,9 @@ class ParameterManager:
                  log_path: Optional[str] = None,
                  decide_fn=None,
                  search: str = "sweep",
-                 bayes_rounds: int = 12):
+                 bayes_rounds: int = 12,
+                 candidate_pub=None,
+                 candidate_fetch=None):
         """``decide_fn(local_best_threshold) -> final_threshold``: the
         SynchronizeParameters hook (parameter_manager.h) — in
         multi-controller mode, rank 0's choice is published through the
@@ -61,12 +63,27 @@ class ParameterManager:
         if search == "bayes" and enabled:
             # Knob space: log2(bytes) in [20, 28] = 1 MB .. 256 MB, the same
             # span as the sweep candidates (bayesian_optimization.cc model).
-            from .optim import BayesianOptimizer
-            self._bo = BayesianOptimizer(low=20.0, high=28.0)
+            # Multi-controller: rank 0 owns the GP and PUBLISHES each
+            # round's candidate (candidate_pub); followers FETCH it
+            # (candidate_fetch) so exploration thresholds — and therefore
+            # fusion buckets — stay identical on every rank (the
+            # reference's rank-0-tunes + SynchronizeParameters design,
+            # parameter_manager.h).  Round advancement is sample-count
+            # driven, identical everywhere.
             self._bo_rounds = bayes_rounds
             self._bo_round = 0
-            self._bo_current = self._bo.suggest()
             self._bo_scores: List[float] = []
+            self._cand_pub = candidate_pub
+            self._cand_fetch = candidate_fetch
+            if candidate_fetch is None:
+                from .optim import BayesianOptimizer
+                self._bo = BayesianOptimizer(low=20.0, high=28.0)
+                self._bo_current = self._bo.suggest()
+                if candidate_pub is not None:
+                    candidate_pub(0, float(self._bo_current))
+            else:
+                self._bo = None
+                self._bo_current = float(candidate_fetch(0))
 
     @property
     def fusion_threshold_bytes(self) -> int:
@@ -93,12 +110,18 @@ class ParameterManager:
                     f"{int(2 ** self._bo_current)},{score:.1f}\n")
                 self._log.flush()
             if len(self._bo_scores) >= self.samples_per_candidate:
-                self._bo.observe(self._bo_current,
-                                 sum(self._bo_scores) / len(self._bo_scores))
+                if self._bo is not None:
+                    self._bo.observe(
+                        self._bo_current,
+                        sum(self._bo_scores) / len(self._bo_scores))
                 self._bo_scores = []
                 self._bo_round += 1
                 if self._bo_round >= self._bo_rounds:
-                    local = int(2 ** self._bo.best())
+                    # Controller converges on its GP optimum; followers'
+                    # decide_fn blocks on the controller's published
+                    # decision (core.py _synced_decision).
+                    local = int(2 ** (self._bo.best() if self._bo is not None
+                                      else self._bo_current))
                     self._threshold = (self._decide_fn(local)
                                        if self._decide_fn else local)
                     self._converged = True
@@ -106,8 +129,14 @@ class ParameterManager:
                         self._log.write(
                             f"# converged threshold={self._threshold}\n")
                         self._log.flush()
-                else:
+                elif self._bo is not None:
                     self._bo_current = self._bo.suggest()
+                    if self._cand_pub is not None:
+                        self._cand_pub(self._bo_round,
+                                       float(self._bo_current))
+                else:
+                    self._bo_current = float(
+                        self._cand_fetch(self._bo_round))
             return
         self._scores[self._idx].append(score)
         if self._log:

@@ -57,6 +57,14 @@ def _build_mesh(topo: _topology.Topology, cfg: _config.Config):
     return Mesh(np.asarray(devices), (cfg.mesh_axis,))
 
 
+def _autotune_scope() -> str:
+    """KV scope for autotune sync, namespaced by the negotiation generation:
+    keys from a previous world incarnation (elastic reset) must never feed
+    a fresh ParameterManager — a follower reading a stale candidate would
+    explore a different fusion threshold than rank 0's new GP run."""
+    return f"autotune@{os.environ.get('HVD_TPU_NEGOTIATION_GEN', '0')}"
+
+
 def _maybe_join_distributed(cfg: _config.Config) -> None:
     """Join the multi-process JAX runtime when launched by horovodrun.
 
@@ -144,37 +152,66 @@ def init(comm: Optional[Sequence[int]] = None,
             import time as _time
             from .runner.http_server import KVStoreClient
             client = KVStoreClient(addr, int(port))
+            scope = _autotune_scope()
             if topo.rank == 0:
-                client.put("autotune", "threshold",
+                client.put(scope, "threshold",
                            _json.dumps({"threshold": local_choice}).encode())
                 return local_choice
             deadline = _time.time() + 60
             while _time.time() < deadline:
-                raw = client.get("autotune", "threshold")
+                raw = client.get(scope, "threshold")
                 if raw is not None:
                     return int(_json.loads(raw)["threshold"])
                 _time.sleep(0.05)
             return local_choice
 
         search = cfg.autotune_search
+        candidate_pub = candidate_fetch = None
         if cfg.autotune and search == "bayes" and topo.size > 1 and \
                 not topo.emulated:
-            # BO's schedule depends on rank-local scores: divergent
-            # candidates during exploration would desynchronize fusion
-            # buckets across ranks.  The deterministic sweep explores
-            # identically everywhere; BO serves the single-controller case
-            # (one process driving the whole slice — the common SPMD mode).
-            get_logger().warning(
-                "HOROVOD_AUTOTUNE_SEARCH=bayes requires single-controller "
-                "mode; falling back to the deterministic sweep")
-            search = "sweep"
+            # Multi-controller BO: rank 0 owns the GP and publishes each
+            # round's exploration candidate through the rendezvous KV;
+            # followers fetch it, so fusion buckets stay identical on
+            # every rank (the reference's rank-0-tunes +
+            # SynchronizeParameters design, parameter_manager.h).
+            addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+            port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+            if not addr or not port:
+                get_logger().warning(
+                    "HOROVOD_AUTOTUNE_SEARCH=bayes needs the rendezvous KV "
+                    "to sync candidates; falling back to the sweep")
+                search = "sweep"
+            else:
+                import json as _json
+                import time as _time
+                from .runner.http_server import KVStoreClient
+                _cli = KVStoreClient(addr, int(port))
+                _scope = _autotune_scope()
+                if topo.rank == 0:
+                    def candidate_pub(round_, value):
+                        _cli.put(_scope, f"cand/{round_}",
+                                 _json.dumps(value).encode())
+                else:
+                    def candidate_fetch(round_):
+                        deadline = _time.time() + 120
+                        while _time.time() < deadline:
+                            raw = _cli.get(_scope, f"cand/{round_}")
+                            if raw is not None:
+                                return float(_json.loads(raw))
+                            _time.sleep(0.05)
+                        from .exceptions import HorovodInternalError
+                        raise HorovodInternalError(
+                            f"timed out fetching autotune candidate for "
+                            f"round {round_} from rank 0")
         _state.param_manager = ParameterManager(
             enabled=cfg.autotune,
             initial_threshold=cfg.fusion_threshold_bytes,
             log_path=cfg.autotune_log if topo.rank == 0 else None,
             decide_fn=_synced_decision,
             search=search,
-            bayes_rounds=cfg.autotune_bayes_rounds)
+            bayes_rounds=cfg.autotune_bayes_rounds,
+            candidate_pub=candidate_pub,
+            candidate_fetch=candidate_fetch)
         if cfg.timeline_path and topo.rank == 0:
             # Rank 0 writes the trace, like the reference coordinator
             # (HOROVOD_TIMELINE, operations.cc:1077).

@@ -211,6 +211,11 @@ class EagerEngine:
         else:
             label = name
         self.claim_name(name)
+        # Profiler op range (the NVTX bracket of nvtx_op_range.h:65,79):
+        # every eager dispatch shows up as one named range in jax.profiler
+        # traces, spanning negotiation + execution.
+        prof_range = jax.profiler.TraceAnnotation(f"hvd::{kind}::{label}")
+        prof_range.__enter__()
         try:
             if tl is not None:
                 tl.negotiate_start(label, kind.upper())
@@ -286,6 +291,7 @@ class EagerEngine:
                 if tl is not None:
                     tl.end(label, kind.upper())
         finally:
+            prof_range.__exit__(None, None, None)
             self.release_name(name)
 
     # -- native core hooks ----------------------------------------------------

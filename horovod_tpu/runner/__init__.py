@@ -51,7 +51,7 @@ def run(func: Callable,
         os.makedirs(base, exist_ok=True)
         workdir = tempfile.mkdtemp(prefix="run_", dir=base)
         from ..utils import get_logger
-        get_logger().info(
+        get_logger().warning(
             "run(): remote hosts %s read the pickled function from %s — "
             "the working tree must be a shared mount",
             [h.hostname for h in _hosts_mod.parse_hosts(hosts)
@@ -74,7 +74,15 @@ def run(func: Callable,
     bootstrap = f"""
 import pickle, os, sys, urllib.request
 sys.path[:0] = [p for p in {parent_path!r} if p not in sys.path]
-fn, a, kw = pickle.load(open({fn_path!r}, 'rb'))
+try:
+    fh = open({fn_path!r}, 'rb')
+except FileNotFoundError:
+    print('horovod_tpu.run: cannot read the pickled function at '
+          {fn_path!r} + ' — remote hosts need the launcher working tree '
+          'on a SHARED mount (the function ships via the filesystem; '
+          'results return via the rendezvous KV)', file=sys.stderr)
+    raise
+fn, a, kw = pickle.load(fh)
 r = fn(*a, **kw)
 rank = int(os.environ.get('HOROVOD_RANK', 0))
 payload = pickle.dumps(r)
@@ -89,12 +97,9 @@ try:
     sent = True
 except Exception as e:
     print('result KV put failed: %r' % (e,), file=sys.stderr)
-try:
+if not sent:
     open(os.path.join({workdir!r}, 'result_%d.pkl' % rank), 'wb') \\
         .write(payload)
-except OSError:
-    if not sent:
-        raise
 """
     argv = ["-np", str(np)]
     if hosts:
@@ -128,4 +133,6 @@ except OSError:
         path = os.path.join(workdir, f"result_{rank}.pkl")
         with open(path, "rb") as f:
             results.append(pickle.load(f))
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)  # pickles must not linger
     return results
