@@ -210,13 +210,16 @@ class EagerEngine:
             label = f"{kind}.noname.{fp}"
         else:
             label = name
-        self.claim_name(name)
         # Profiler op range (the NVTX bracket of nvtx_op_range.h:65,79):
         # every eager dispatch shows up as one named range in jax.profiler
-        # traces, spanning negotiation + execution.
+        # traces, spanning negotiation + execution.  Entered BEFORE the
+        # name claim so no exception path can leak a claimed name.
         prof_range = jax.profiler.TraceAnnotation(f"hvd::{kind}::{label}")
         prof_range.__enter__()
+        claimed = False
         try:
+            self.claim_name(name)
+            claimed = True
             if tl is not None:
                 tl.negotiate_start(label, kind.upper())
                 tl.negotiate_rank_ready(label, self.topo.rank)
@@ -292,7 +295,8 @@ class EagerEngine:
                     tl.end(label, kind.upper())
         finally:
             prof_range.__exit__(None, None, None)
-            self.release_name(name)
+            if claimed:
+                self.release_name(name)
 
     # -- native core hooks ----------------------------------------------------
 

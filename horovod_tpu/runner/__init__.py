@@ -120,19 +120,24 @@ if not sent:
         # The dict object outlives the server shutdown.
         captured["kv"] = rendezvous.httpd.cache
 
-    ret = _run_static(parsed, on_rendezvous=_capture)
-    if ret != 0:
-        raise RuntimeError(f"horovod_tpu.run failed with exit code {ret}")
-    kv_results = captured.get("kv", {}).get("runresults", {})
-    results = []
-    for rank in range(np):
-        raw = kv_results.get(str(rank))
-        if raw is not None:
-            results.append(pickle.loads(raw))
-            continue
-        path = os.path.join(workdir, f"result_{rank}.pkl")
-        with open(path, "rb") as f:
-            results.append(pickle.load(f))
-    import shutil
-    shutil.rmtree(workdir, ignore_errors=True)  # pickles must not linger
-    return results
+    try:
+        ret = _run_static(parsed, on_rendezvous=_capture)
+        if ret != 0:
+            raise RuntimeError(
+                f"horovod_tpu.run failed with exit code {ret}")
+        kv_results = captured.get("kv", {}).get("runresults", {})
+        results = []
+        for rank in range(np):
+            raw = kv_results.get(str(rank))
+            if raw is not None:
+                results.append(pickle.loads(raw))
+                continue
+            path = os.path.join(workdir, f"result_{rank}.pkl")
+            with open(path, "rb") as f:
+                results.append(pickle.load(f))
+        return results
+    finally:
+        # The staged function pickle can embed caller data; it must not
+        # linger (especially on a shared mount) on ANY exit path.
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)

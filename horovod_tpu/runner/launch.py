@@ -314,6 +314,52 @@ def _run_static(args, on_rendezvous=None) -> int:
     has_remote = any(not _is_local(h.hostname) for h in host_list)
     addr = socket.gethostbyname(socket.gethostname()) if has_remote \
         else "127.0.0.1"
+    if has_remote:
+        # NIC selection (driver_service.py:122-194): explicit
+        # --network-interface wins; otherwise probe every remote host and
+        # pick a launcher address they can all actually reach.
+        from . import nic_probe
+        if args.nics:
+            explicit = nic_probe.addr_for_interfaces(args.nics.split(","))
+            if explicit:
+                addr = explicit
+        else:
+            try:
+                import shlex
+                remote = sorted({h.hostname for h in host_list
+                                 if not _is_local(h.hostname)})
+                candidates = [addr] + [
+                    a for addrs in
+                    nic_probe.local_interfaces().values() for a in addrs
+                    if a != addr]
+                cand_arg = ",".join(f"{a}:{port}" for a in candidates)
+
+                def spawn_probe(host):
+                    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+                    if args.ssh_port:
+                        cmd += ["-p", str(args.ssh_port)]
+                    if args.ssh_identity_file:
+                        cmd += ["-i", args.ssh_identity_file]
+                    cmd += [host,
+                            f"cd {shlex.quote(os.getcwd())} && "
+                            f"{shlex.quote(sys.executable)} -m "
+                            f"horovod_tpu.runner.nic_probe --candidates "
+                            f"{cand_arg} --host {host}"]
+                    safe_shell_exec.execute(cmd, env=dict(os.environ))
+
+                _, routable = nic_probe.discover_common_address(
+                    rendezvous, remote, spawn_probe, candidates, port,
+                    timeout=float(os.environ.get(
+                        "HVD_TPU_NIC_PROBE_TIMEOUT", "30")))
+                if routable:
+                    addr = routable
+                else:
+                    print(f"horovodrun: no probed launcher address was "
+                          f"reachable from all hosts; falling back to "
+                          f"{addr}", file=sys.stderr)
+            except Exception as e:
+                print(f"horovodrun: NIC probing failed ({e}); using "
+                      f"{addr}", file=sys.stderr)
     # The jax.distributed coordinator runs inside rank 0's process.  With any
     # remote worker in the job, loopback would point remote workers at
     # themselves — use a routable name for rank 0's host instead.

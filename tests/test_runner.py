@@ -292,10 +292,13 @@ def test_remote_ssh_command_synthesis(monkeypatch):
         return 0
 
     monkeypatch.setattr(launch_mod.safe_shell_exec, "execute", fake_execute)
+    # The NIC probe would wait for registrations the fake ssh never makes.
+    monkeypatch.setenv("HVD_TPU_NIC_PROBE_TIMEOUT", "0.2")
     args = launch_mod.parse_args(
         ["-np", "2", "-H", "remotebox:2", "-p", "2222",
          "python", "train.py"])
     assert launch_mod._run_static(args) == 0
+    calls = [c for c in calls if "nic_probe" not in " ".join(map(str, c[0]))]
     assert len(calls) == 2
     for i, (cmd, env) in enumerate(sorted(calls, key=lambda c:
                                           c[1]["HOROVOD_RANK"])):
@@ -407,3 +410,77 @@ def test_spark_run_env_injection_mocked(monkeypatch):
         assert env["HOROVOD_SIZE"] == "2"
         assert env["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
         assert "HVD_TPU_COORDINATOR" in env
+
+
+# ---------------------------------------------------------------------------
+# NIC probing / interface intersection (driver_service.py:122-194 analog)
+# ---------------------------------------------------------------------------
+
+def test_probe_and_report_reachability():
+    """The probe tests every candidate against the live KV port (the
+    reachability test IS the registration transport) and publishes one
+    report through a working candidate."""
+    from horovod_tpu.runner.nic_probe import PROBE_SCOPE, probe_and_report
+    kv = KVStoreServer()
+    port = kv.start()
+    try:
+        ok = probe_and_report(
+            "h1",
+            [("127.0.0.1", port),   # live KV
+             ("127.0.0.2", 1)],     # nothing listening
+            interfaces={"eth0": ["10.0.0.9"]})
+        assert ok
+        rep = json.loads(kv.get(PROBE_SCOPE, "report/h1"))
+        assert rep["interfaces"] == {"eth0": ["10.0.0.9"]}
+        assert rep["reachable"] == ["127.0.0.1"]
+    finally:
+        kv.stop()
+
+
+def test_probe_and_report_no_reachable_candidate():
+    from horovod_tpu.runner.nic_probe import probe_and_report
+    assert probe_and_report("h1", [("127.0.0.2", 1)],
+                            interfaces={}) is False
+
+
+def test_discover_common_address_end_to_end():
+    """Launcher-side flow with in-process probes standing in for the
+    ssh-launched remote ones (no sshd in this image; the ssh command
+    synthesis is covered by test_remote_ssh_command_synthesis).
+    Interface intersection includes the launcher's own interfaces, and
+    the routable pick needs EVERY host to report the candidate."""
+    from horovod_tpu.runner.nic_probe import (
+        discover_common_address, local_interfaces, probe_and_report)
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    local_names = list(local_interfaces().keys())
+    fake_ifaces = {
+        "hA": {n: ["10.0.0.1"] for n in local_names + ["ibX"]},
+        "hB": {n: ["10.0.0.2"] for n in local_names},
+    }
+
+    def spawn(host):
+        probe_and_report(host, [("127.0.0.1", kv_port), ("127.0.0.2", 1)],
+                         interfaces=fake_ifaces[host])
+
+    try:
+        common, routable = discover_common_address(
+            kv, ["hA", "hB"], spawn,
+            candidate_addrs=["127.0.0.2", "127.0.0.1"],
+            candidate_port=kv_port, timeout=10)
+        assert routable == "127.0.0.1"  # the only addr both hosts reached
+        assert common == sorted(local_names)
+    finally:
+        kv.stop()
+
+
+def test_discover_common_address_missing_probe_times_out():
+    from horovod_tpu.runner.nic_probe import discover_common_address
+    kv = KVStoreServer()
+    kv.start()
+    try:
+        with pytest.raises(TimeoutError, match="never reported"):
+            discover_common_address(kv, ["ghost"], lambda h: None,
+                                    ["127.0.0.1"], 1, timeout=1.0)
+    finally:
+        kv.stop()
