@@ -64,6 +64,28 @@ def _members(process_set: Optional[ProcessSet]):
     return process_set.members()
 
 
+def _wire_ps(process_set: Optional[ProcessSet]) -> dict:
+    """Canonical wire identity of a process set for negotiation signatures.
+
+    The LOCAL process_set_id depends on per-rank registration order, so it
+    must never cross the wire: two ranks that registered the same sets in a
+    different order would fail validation on a perfectly matched collective,
+    and a joined rank could replay a record against the wrong set.  Instead
+    the wire carries (a) a membership-derived 31-bit id (FNV-1a over the
+    sorted ranks — order-independent, feeds the native cache/message table)
+    and (b) the member ranks themselves, from which a replaying rank
+    resolves — or auto-registers — the matching local set.  Reference
+    semantics: process-set ids are agreed collectively at registration
+    (operations.cc:1262); here the membership IS the agreement."""
+    members = _members(process_set)
+    if members is None:
+        return {"ps_id": 0, "ps_ranks": None}
+    h = 0x811C9DC5
+    for r in members:
+        h = ((h ^ (r + 1)) * 0x01000193) & 0x7FFFFFFF
+    return {"ps_id": h or 1, "ps_ranks": list(members)}
+
+
 def _normalize_op(op, average):
     """Resolve the deprecated ``average`` flag vs ``op``
     (torch/mpi_ops.py:110-150 handle_average_backwards_compatibility)."""
@@ -132,7 +154,7 @@ def allreduce(tensor,
                   (int(rop), members, prescale_factor, postscale_factor),
                   single, name=name, op_id=int(rop),
                   prescale=prescale_factor, postscale=postscale_factor,
-                  ps_id=process_set.process_set_id or 0)[0]
+                  **_wire_ps(process_set))[0]
     return compression.decompress(out, ctx)
 
 
@@ -193,7 +215,7 @@ def grouped_allreduce(tensors: Sequence,
                        (int(rop), members, prescale_factor, postscale_factor),
                        single, name=name, op_id=int(rop),
                        prescale=prescale_factor, postscale=postscale_factor,
-                       ps_id=process_set.process_set_id or 0)
+                       **_wire_ps(process_set))
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
 
 
@@ -252,7 +274,7 @@ def _fused_allreduce(tensors: Sequence, op,
                   single, name=f"fusedbuf.{dtype}.{int(offsets[-1])}",
                   op_id=int(rop), prescale=prescale_factor,
                   postscale=postscale_factor,
-                  ps_id=process_set.process_set_id or 0)[0]
+                  **_wire_ps(process_set))[0]
     return [out[int(a):int(b)].reshape(s)
             for a, b, s in zip(offsets[:-1], offsets[1:], shapes)]
 
@@ -294,7 +316,7 @@ def allgather(tensor, name: Optional[str] = None,
 
     return eng.run("allgather", body, [tensor], (members,), single,
                    name=name,
-                   ps_id=process_set.process_set_id or 0)[0]
+                   **_wire_ps(process_set))[0]
 
 
 def _allgatherv_emulated(tensors: List, members) -> List:
@@ -402,7 +424,7 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     return eng.run("broadcast", body, [tensor], (root_rank, members),
                    single, name=name, stacked=stacked,
                    op_id=int(root_rank),
-                   ps_id=process_set.process_set_id or 0)[0]
+                   **_wire_ps(process_set))[0]
 
 
 def broadcast_async(tensor, root_rank: int = 0, name=None,
@@ -444,7 +466,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
 
         return eng.run("alltoall", body, [tensor], (members,), single,
                        name=name,
-                       ps_id=process_set.process_set_id or 0)[0]
+                       **_wire_ps(process_set))[0]
 
     if _axis_bound(axis):
         raise ValueError(
@@ -541,7 +563,7 @@ def reducescatter(tensor, op=ReduceOp.SUM, name: Optional[str] = None,
                    (int(rop), members, prescale_factor, postscale_factor),
                    single, name=name, op_id=int(rop),
                    prescale=prescale_factor, postscale=postscale_factor,
-                   ps_id=process_set.process_set_id or 0)[0]
+                   **_wire_ps(process_set))[0]
 
 
 def reducescatter_async(tensor, op=ReduceOp.SUM, name=None,

@@ -184,7 +184,8 @@ class EagerEngine:
             op_id: int = 0,
             prescale: float = 1.0,
             postscale: float = 1.0,
-            ps_id: int = 0) -> List[jax.Array]:
+            ps_id: int = 0,
+            ps_ranks=None) -> List[jax.Array]:
         """Dispatch one eager collective; returns per-rank outputs
         (stacked in emulated mode, local otherwise).
 
@@ -272,7 +273,7 @@ class EagerEngine:
                     neg.negotiate(label, kind, dtype_sig, tuple(shape_sig),
                                   op_id, prescale=prescale,
                                   postscale=postscale, ps_id=ps_id,
-                                  timeline=tl)
+                                  ps_ranks=ps_ranks, timeline=tl)
                 mesh = self._multiproc_mesh()
                 try:
                     global_ts = [self._to_global(t) for t in tensors]
@@ -281,15 +282,31 @@ class EagerEngine:
                     if not isinstance(outs, (tuple, list)):
                         outs = [outs]
                     return [self._from_global(o) for o in outs]
-                except jax.errors.JaxRuntimeError as e:
+                except Exception as e:
                     # A failed compiled collective (peer died, gloo/ICI
                     # context torn down mid-run) is the reference's
                     # HorovodInternalError contract (exceptions.py:18) —
                     # elastic restores the last commit and re-initializes.
+                    # PJRT surfaces these inconsistently — JaxRuntimeError
+                    # for most, but a gloo TCP reset arrives as a plain
+                    # ValueError("UNKNOWN: Gloo all-reduce failed ...") —
+                    # so match on the runtime-failure text, not the type,
+                    # and never swallow genuine programming errors.
                     from ..exceptions import HorovodInternalError
-                    raise HorovodInternalError(
-                        f"collective {label!r} failed on the device "
-                        f"runtime: {e}") from e
+                    if isinstance(e, HorovodInternalError):
+                        raise
+                    msg = str(e)
+                    runtime_markers = (
+                        "Gloo", "gloo", "UNKNOWN:", "INTERNAL:",
+                        "DEADLINE_EXCEEDED", "Connection reset",
+                        "Socket closed", "coordination service",
+                        "UNAVAILABLE:", "ABORTED:")
+                    if isinstance(e, jax.errors.JaxRuntimeError) or \
+                            any(m in msg for m in runtime_markers):
+                        raise HorovodInternalError(
+                            f"collective {label!r} failed on the device "
+                            f"runtime: {e}") from e
+                    raise
             finally:
                 if tl is not None:
                     tl.end(label, kind.upper())
@@ -420,8 +437,7 @@ class EagerEngine:
         self.negotiator._epochs[name] = rec["epoch"]
         op_id = sig["op"]
         pre, post = sig.get("prescale", 1.0), sig.get("postscale", 1.0)
-        ps = _core._require_init().process_set_table.get(
-            sig.get("ps_id", 0))
+        ps = self._resolve_replay_ps(sig)
         if kind not in ("allreduce", "grouped_allreduce", "broadcast",
                         "reducescatter", "alltoall", "barrier"):
             raise HorovodInternalError(
@@ -473,6 +489,21 @@ class EagerEngine:
             # published — streams stay aligned, so servicing can continue.
             get_logger().warning("join: replayed %s was rejected: %s",
                                  name, e)
+
+    def _resolve_replay_ps(self, sig: dict):
+        """Resolve the process set of a replayed dispatch from its WIRE
+        membership (sig['ps_ranks'], see ops._wire_ps) — never from a local
+        id, which depends on per-rank registration order.  A joined rank
+        that never registered the set auto-registers it here (register()
+        dedups against an existing identical set), so join + subset
+        collectives reconcile without any registration-order contract."""
+        from .. import core as _core
+        from ..process_sets import ProcessSet
+        ranks = sig.get("ps_ranks")
+        if not ranks:
+            return _core._require_init().process_set_table.global_set
+        return _core._require_init().process_set_table.register(
+            ProcessSet(ranks))
 
     def _replay_allgather_record(self, rec: dict, kind: str, name: str,
                                  dtypes, shapes) -> None:

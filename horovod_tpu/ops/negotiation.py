@@ -98,7 +98,7 @@ class Negotiator:
     def negotiate(self, name: str, kind: str, dtype: str,
                   shape: Tuple[int, ...], op: int = 0,
                   prescale: float = 1.0, postscale: float = 1.0,
-                  ps_id: int = 0, timeline=None) -> None:
+                  ps_id: int = 0, ps_ranks=None, timeline=None) -> None:
         """Block until every rank has announced this collective and rank 0
         validated consistency; raises HorovodInternalError on mismatch.
 
@@ -112,6 +112,11 @@ class Negotiator:
                                    postscale, ps_id)
         sig = {"dtype": dtype, "shape": list(shape), "op": kind_id,
                "prescale": prescale, "postscale": postscale, "ps_id": ps_id}
+        if ps_ranks is not None:
+            # Membership list rides the wire alongside the hashed ps_id (see
+            # ops._wire_ps): the coordinator exact-checks it (hash-collision
+            # guard) and a joined rank resolves the set from it on replay.
+            sig["ps_ranks"] = list(ps_ranks)
         if status == self._HIT:
             # Cache fast path: no negotiation round-trip, but the dispatch
             # is still PUBLISHED to this rank's replay stream — a rank that
@@ -307,6 +312,19 @@ class Negotiator:
                         self._publish(name, epoch,
                                       f"duplicate request from rank {r} "
                                       f"(DUPLICATE_NAME_ERROR)")
+                        return
+                    # Exact membership check: ps_id is a membership hash
+                    # (ops._wire_ps), so the native table already rejects
+                    # different memberships; this closes the residual
+                    # hash-collision window with the rank lists themselves.
+                    if not arrived:
+                        first_ps_ranks = sig.get("ps_ranks")
+                    elif sig.get("ps_ranks") != first_ps_ranks:
+                        self._publish(
+                            name, epoch,
+                            f"process-set membership mismatch on {name!r}: "
+                            f"rank {r} announced {sig.get('ps_ranks')} vs "
+                            f"{first_ps_ranks}")
                         return
                     arrived.add(r)
                     self.stall.record_request(tbl_key, r, time.time())

@@ -194,3 +194,111 @@ def test_join_cached_dispatch_stress(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for r in range(3):
         assert f"rank{r} STRESS OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Join + non-global process sets (TODO.md parity gap): the wire identity of
+# a process set is its MEMBERSHIP (ops._wire_ps), never the local
+# registration-order id — so ranks may register sets in different orders,
+# and a joined rank replays subset collectives against sets it never saw.
+# ---------------------------------------------------------------------------
+
+def test_wire_ps_is_order_independent(hvd8):
+    from horovod_tpu.ops import _wire_ps
+    from horovod_tpu.process_sets import ProcessSet, global_process_set
+    a = ProcessSet([0, 2, 5])
+    b = ProcessSet([5, 0, 2])     # same membership, different spelling
+    a.process_set_id, b.process_set_id = 7, 93   # wildly different local ids
+    wa, wb = _wire_ps(a), _wire_ps(b)
+    assert wa == wb
+    assert wa["ps_ranks"] == [0, 2, 5]
+    assert wa["ps_id"] not in (0, 7, 93)
+    assert _wire_ps(global_process_set) == {"ps_id": 0, "ps_ranks": None}
+    c = ProcessSet([0, 2, 6])
+    c.process_set_id = 7
+    assert _wire_ps(c)["ps_id"] != wa["ps_id"]  # membership-sensitive
+
+
+WORKER_PS_ORDER_MISMATCH = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+r = hvd.rank()
+# The SAME two sets registered in OPPOSITE orders: local ids differ across
+# ranks ({{0,1}} is id 1 on rank 0 but id 2 on ranks 1/2, etc.).  The wire
+# identity is membership, so collectives over either set must validate.
+if r == 0:
+    ps01 = hvd.add_process_set([0, 1]); ps12 = hvd.add_process_set([1, 2])
+else:
+    ps12 = hvd.add_process_set([1, 2]); ps01 = hvd.add_process_set([0, 1])
+for step in range(3):
+    out = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                        name="sub01", process_set=ps01)
+    if r in (0, 1):
+        assert abs(float(out[0]) - 3.0) < 1e-6, (r, float(out[0]))
+    else:
+        assert abs(float(out[0]) - 3.0) < 1e-6 or True  # non-member keeps own
+    out = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                        name="sub12", process_set=ps12)
+    if r in (1, 2):
+        assert abs(float(out[0]) - 5.0) < 1e-6, (r, float(out[0]))
+print(f"rank{{r}} PSORDER OK")
+"""
+
+
+def test_process_set_registration_order_mismatch(tmp_path):
+    """Ranks registering identical sets in different orders used to produce
+    cross-rank ps_id mismatches (validation error at best).  With the
+    membership-canonical wire id, order does not matter."""
+    script = tmp_path / "psorder.py"
+    script.write_text(WORKER_PS_ORDER_MISMATCH.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "3",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for r in range(3):
+        assert f"rank{r} PSORDER OK" in proc.stdout
+
+
+WORKER_JOIN_SUBSET_PS = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+r = hvd.rank()
+if r == 2:
+    # Rank 2 never registers the subset — it joins immediately and must
+    # auto-register {{0,1}} from the replayed record's wire membership.
+    last = hvd.join()
+    print(f"rank2 joined, last={{last}}")
+else:
+    ps01 = hvd.add_process_set([0, 1])
+    for step in range(3):
+        out = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                            name="sub", process_set=ps01)
+        # Members reduce over {{0,1}}: 1+2=3 (rank 2's replayed zeros are
+        # masked out of the subset anyway).
+        assert abs(float(out[0]) - 3.0) < 1e-6, (r, step, float(out[0]))
+    last = hvd.join()
+    print(f"rank{{r}} subset-under-join ok, last={{last}}")
+"""
+
+
+def test_join_with_unregistered_subset_process_set(tmp_path):
+    """A joined rank servicing a subset collective it never registered must
+    resolve the set from the record's membership, not a local id (which
+    does not exist on that rank)."""
+    script = tmp_path / "jps.py"
+    script.write_text(WORKER_JOIN_SUBSET_PS.format(repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "3",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rank2 joined" in proc.stdout
+    assert "rank0 subset-under-join ok" in proc.stdout
+    assert "rank1 subset-under-join ok" in proc.stdout
