@@ -79,6 +79,17 @@ def _maybe_join_distributed(cfg: _config.Config) -> None:
     port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
     if rank is None or size is None or int(size) <= 1 or addr is None:
         return
+    if os.environ.get("HOROVOD_ELASTIC") == "1":
+        # Meet every peer incarnation of this world generation BEFORE
+        # touching jax.distributed — a non-converging initialize aborts
+        # the process (see elastic._await_world_at_init_barrier).  The
+        # barrier may adopt a newer world, so re-read the slot env after.
+        from .elastic import _await_world_at_init_barrier
+        _await_world_at_init_barrier()
+        rank = os.environ.get(_config.HOROVOD_RANK)
+        size = os.environ.get(_config.HOROVOD_SIZE)
+        if rank is None or size is None or int(size) <= 1:
+            return
     # Must not touch the XLA backend (e.g. jax.devices/process_count) before
     # jax.distributed.initialize — probe the distributed client state instead.
     import jax
@@ -87,10 +98,25 @@ def _maybe_join_distributed(cfg: _config.Config) -> None:
         return  # already initialized by the user
     coordinator = os.environ.get(
         "HVD_TPU_COORDINATOR", f"{addr}:{int(port) + 1 if port else 9999}")
+    # Bounded init: an elastic in-place reset can otherwise block the full
+    # default 300 s inside initialize() waiting for a peer that is dead and
+    # will re-rendezvous into a DIFFERENT world generation.  The elastic
+    # retry loop handles the timeout (upgrade to a world refresh).
+    init_timeout = int(float(os.environ.get(
+        "HVD_TPU_DIST_INIT_TIMEOUT_S",
+        os.environ.get(_config.HOROVOD_GLOO_TIMEOUT_SECONDS, "300"))))
+    # A dead peer makes jax.distributed.shutdown's barrier hang the full
+    # shutdown timeout before the client aborts the process; bound it so a
+    # doomed survivor dies (and gets respawned into a fresh world) quickly.
+    # Healthy same-world resets clear the barrier in well under a second.
+    shutdown_timeout = int(float(os.environ.get(
+        "HVD_TPU_DIST_SHUTDOWN_TIMEOUT_S", "60")))
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(size),
         process_id=int(rank),
+        initialization_timeout=init_timeout,
+        shutdown_timeout_seconds=shutdown_timeout,
     )
 
 

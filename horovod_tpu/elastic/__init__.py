@@ -125,20 +125,31 @@ class WorkerNotificationManager:
 notification_manager = WorkerNotificationManager()
 
 
-def _refresh_world_from_rendezvous() -> None:
+def _refresh_world_from_rendezvous(allow_same_world: bool = False) -> str:
     """After a reset, fetch this worker's new slot record keyed by
     (hostname, local_rank) from the rendezvous KV store and refresh the
     HOROVOD_* env (the gloo elastic re-rendezvous pattern,
-    runner/http/http_server.py elastic handler).
+    runner/http/http_server.py elastic handler).  Returns "refreshed"
+    when a NEW world's slot was adopted, "same_world" on the
+    allow_same_world fallback below.
 
     Version gate: the KV store still holds the previous world's records
     while the driver reshapes; we wait for a world version strictly newer
     than the one we left (HVD_TPU_WORLD_VERSION) and a slot record stamped
-    with that version."""
+    with that version.
+
+    ``allow_same_world``: the retry loop escalates repeated in-place reset
+    failures to a world refresh on the ASSUMPTION the world changed under
+    us — but when it did not (transient churn: a peer wedged in a timing-
+    out collective), waiting for a strictly newer version deadlocks until
+    the elastic timeout while live peers train on.  With this flag, if no
+    newer world appears within a bounded window and the CURRENT world
+    still lists this worker's slot, return "same_world" so the caller
+    falls back to an in-place (generation-bump) reset instead."""
     addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
     port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
     if not addr or not port:
-        return
+        return "refreshed"
     from ..runner.http_server import KVStoreClient
     client = KVStoreClient(addr, int(port))
     hostname = os.environ.get(_config.HOROVOD_HOSTNAME, socket.gethostname())
@@ -146,11 +157,24 @@ def _refresh_world_from_rendezvous() -> None:
     last_version = int(os.environ.get("HVD_TPU_WORLD_VERSION", "0"))
     deadline = time.time() + float(
         os.environ.get(_config.HOROVOD_ELASTIC_TIMEOUT, "600"))
+    same_world_after = time.time() + float(
+        os.environ.get("HVD_TPU_SAME_WORLD_FALLBACK_S", "20"))
     scaled_out_since = None
     while time.time() < deadline:
         try:
             world_raw = client.get("rendezvous", "world")
             world = json.loads(world_raw) if world_raw else {"version": 0}
+            if allow_same_world and time.time() > same_world_after and \
+                    world.get("version", 0) == last_version:
+                raw = client.get("rendezvous",
+                                 f"slot/{hostname}/{local_rank}")
+                rec = json.loads(raw) if raw else {}
+                if rec.get("version", -1) == last_version:
+                    get_logger().info(
+                        "elastic: world unchanged (v%d) and slot still "
+                        "valid — falling back to in-place reset",
+                        last_version)
+                    return "same_world"
             if world.get("version", 0) > last_version:
                 raw = client.get("rendezvous",
                                  f"slot/{hostname}/{local_rank}")
@@ -183,12 +207,113 @@ def _refresh_world_from_rendezvous() -> None:
                         str(rec["cross_size"])
                     os.environ["HVD_TPU_WORLD_VERSION"] = \
                         str(rec["version"])
-                    return
+                    return "refreshed"
+        except SystemExit:
+            raise
         except Exception as e:
             get_logger().debug("rendezvous refresh retry: %s", e)
         time.sleep(0.5)
     raise HorovodInternalError(
         "timed out waiting for a slot assignment after reset")
+
+
+def _await_world_at_init_barrier() -> None:
+    """Block until EVERY member incarnation of this world generation is
+    alive at this barrier — only then is it safe to enter
+    ``jax.distributed.initialize``.
+
+    Why: a non-converging initialize is not a catchable error — the
+    coordination client ABORTS the process on the RegisterTask deadline
+    (client.h:80).  Without a pre-init rendezvous, respawned incarnations
+    enter initialize at offset times, each abort triggers another driver
+    reshape (new world version, new coordinator port), and the world
+    livelocks with alternating single-sided aborts.  Parking incarnations
+    HERE (pure KV polling, no coordination client) until the full member
+    set of the CURRENT generation is present makes the post-crash cycle
+    converge: the last respawn unblocks everyone simultaneously.
+
+    Presence keys are scoped by WORLD VERSION and carry the same-world
+    reset counter ``c`` of the rank's generation "w.c" as their value.
+    The barrier completes only when every rank of the version is present
+    AT THE SAME ``c`` — and ranks converge on one ``c`` by max-merge:
+    in-place resets are not synchronized (one rank may have failed and
+    bumped several times before its peer's collective even times out),
+    so a rank that sees a LARGER counter announced adopts it (gen +
+    coordinator port) instead of waiting forever at its own.  If the
+    world is superseded while waiting (version moved past ours — our
+    spawn world died), the worker adopts its new slot record and
+    re-announces under the new version; a worker with no slot in the new
+    world exits gracefully via ``_refresh_world_from_rendezvous``.
+
+    Key lifetime: presence keys persist after the barrier completes —
+    safe because the driver bumps the world version on EVERY respawn
+    (record_failure → resume → _activate_world version++), so a fresh
+    incarnation always rendezvouses under a version whose keys only its
+    own world wrote; a completed version's keys are never consulted
+    again.  External launchers that respawn without a version bump would
+    need incarnation-stamped values here."""
+    addr = os.environ.get(_config.HOROVOD_RENDEZVOUS_ADDR)
+    port = os.environ.get(_config.HOROVOD_RENDEZVOUS_PORT)
+    if not addr or not port or os.environ.get("HOROVOD_ELASTIC") != "1":
+        return
+    from ..runner.http_server import KVStoreClient
+    client = KVStoreClient(addr, int(port))
+    deadline = time.time() + float(
+        os.environ.get(_config.HOROVOD_ELASTIC_TIMEOUT, "600"))
+    announced = None  # (version, c) last published
+
+    def _set_gen(w: int, c: int) -> None:
+        os.environ["HVD_TPU_NEGOTIATION_GEN"] = f"{w}.{c}"
+        coord = _coordinator_for_gen(f"{w}.{c}")
+        if coord:
+            os.environ["HVD_TPU_COORDINATOR"] = coord
+
+    while time.time() < deadline:
+        my_version = int(os.environ.get("HVD_TPU_WORLD_VERSION", "0"))
+        gen = os.environ.get("HVD_TPU_NEGOTIATION_GEN", f"{my_version}.0")
+        w, _, c = gen.partition(".")
+        my_c = int(c or 0)
+        rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
+        size = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+        if size <= 1:
+            return  # no peers to meet
+        if announced != (my_version, my_c):
+            client.put("initbar", f"{my_version}/{rank}",
+                       str(my_c).encode())
+            announced = (my_version, my_c)
+        try:
+            raw = client.get("rendezvous", "world")
+            world = json.loads(raw) if raw else {}
+            if world.get("version", my_version) > my_version:
+                # Spawn world superseded: adopt the new world's slot for
+                # this (host, local_rank) and re-announce under it.
+                _refresh_world_from_rendezvous()
+                _set_gen(int(os.environ.get("HVD_TPU_WORLD_VERSION", "0")),
+                         0)
+                continue
+            # One scope scan per poll (O(1) requests per rank per tick;
+            # per-key GETs would put O(size²) load on the KV during init).
+            bar = client.scan("initbar")
+            counters = [int(v) for k, v in bar.items()
+                        if k.startswith(f"{my_version}/")
+                        and int(k.rsplit("/", 1)[1]) < size]
+            cmax = max(counters + [my_c])
+            if cmax > my_c:
+                get_logger().info(
+                    "elastic: init barrier adopting generation %d.%d "
+                    "(peer reset further than us)", my_version, cmax)
+                _set_gen(my_version, cmax)
+                continue
+            if len(counters) >= size and \
+                    all(cc == cmax for cc in counters):
+                return
+        except HorovodInternalError:
+            raise
+        except Exception as e:
+            get_logger().debug("init barrier poll failed: %s", e)
+        time.sleep(0.2)
+    raise HorovodInternalError(
+        "timed out waiting for world members at the init barrier")
 
 
 def coordinator_port_for(base: int, world_version: int,
@@ -215,7 +340,8 @@ def _coordinator_for_gen(gen: str) -> Optional[str]:
     return f"{host}:{coordinator_port_for(int(base), int(w), int(c or 0))}"
 
 
-def _reset(refresh_world: bool = True) -> None:
+def _reset(refresh_world: bool = True,
+           allow_same_world: bool = False) -> None:
     """Full reinit: shutdown the runtime, re-rendezvous, re-init
     (common/elastic.py run_fn 'reinit' = shutdown + re-rendezvous).
 
@@ -228,18 +354,22 @@ def _reset(refresh_world: bool = True) -> None:
     _core.shutdown()
     if os.environ.get("HOROVOD_ELASTIC") == "1":
         if refresh_world:
-            _refresh_world_from_rendezvous()
-            # New world: generation = (world_version, 0).  Newly spawned
-            # workers get the same value from the driver (launch_support),
-            # so every member of the new world scopes its negotiation keys
-            # identically.
-            os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
-                f"{os.environ.get('HVD_TPU_WORLD_VERSION', '0')}.0"
-            coord = _coordinator_for_gen(
-                os.environ["HVD_TPU_NEGOTIATION_GEN"])
-            if coord:
-                os.environ["HVD_TPU_COORDINATOR"] = coord
-        else:
+            outcome = _refresh_world_from_rendezvous(
+                allow_same_world=allow_same_world)
+            if outcome == "same_world":
+                refresh_world = False  # fall through to the gen-bump path
+            else:
+                # New world: generation = (world_version, 0).  Newly
+                # spawned workers get the same value from the driver
+                # (launch_support), so every member of the new world
+                # scopes its negotiation keys identically.
+                os.environ["HVD_TPU_NEGOTIATION_GEN"] = \
+                    f"{os.environ.get('HVD_TPU_WORLD_VERSION', '0')}.0"
+                coord = _coordinator_for_gen(
+                    os.environ["HVD_TPU_NEGOTIATION_GEN"])
+                if coord:
+                    os.environ["HVD_TPU_COORDINATOR"] = coord
+        if not refresh_world:
             # Same world, in-place recovery: every rank received the same
             # collective-failure verdict and resets together — bump the
             # same-world counter so the fresh negotiators never consume the
@@ -300,9 +430,18 @@ def run(func):
     def wrapper(state: State, *args, **kwargs):
         notification_manager.init()
         notification_manager.register_listener(state)
+        # Crash survival: if a previous incarnation of this worker spilled
+        # a commit to disk (HVD_TPU_ELASTIC_SPILL_DIR) that is ahead of the
+        # freshly constructed state, adopt it.  The first-iteration sync()
+        # then broadcasts rank 0's adopted values so the new world agrees.
+        if state.load_spill():
+            get_logger().info(
+                "elastic: resumed from on-disk spill (commit seq %d)",
+                state._commit_seq)
         skip_sync = False
         reset_required = False
         refresh_world = True
+        escalated = False
         reset_failures = 0
         no_progress_failures = 0
         try:
@@ -318,9 +457,15 @@ def run(func):
                     except HostsUpdatedInterrupt as e:
                         skip_sync = e.skip_sync
                         refresh_world = True
+                        escalated = False  # confirmed membership change
                 if reset_required:
                     try:
-                        _reset(refresh_world=refresh_world)
+                        # escalated=True marks refreshes adopted on the
+                        # retry heuristic (not a confirmed host change):
+                        # those may fall back to in-place when the world
+                        # version never actually moved.
+                        _reset(refresh_world=refresh_world,
+                               allow_same_world=escalated)
                     except Exception as e:
                         # Re-init can fail transiently while the new world
                         # is still assembling (jax.distributed barrier or
@@ -343,11 +488,14 @@ def run(func):
                         if reset_failures >= 3:
                             # Same-world retries keep failing: assume the
                             # world DID change under us and wait for a new
-                            # version.
+                            # version (bounded — _reset falls back to
+                            # in-place if the version never moves).
                             refresh_world = True
+                            escalated = True
                         time.sleep(1.0)
                         continue
                     reset_failures = 0
+                    escalated = False
                     # Restore AFTER the backend reset: the in-memory commit
                     # holds host (numpy) copies, so restore re-materializes
                     # arrays on the NEW backend.  (Restoring before the
@@ -361,7 +509,11 @@ def run(func):
                 try:
                     if not skip_sync:
                         state.sync()
-                    return func(state, *args, **kwargs)
+                    result = func(state, *args, **kwargs)
+                    # Completed: drop the spill so a later job reusing the
+                    # directory does not resurrect this run's final state.
+                    state.clear_spill()
+                    return result
                 except HorovodInternalError as e:
                     # Progress bound: a DETERMINISTIC failure (e.g. a
                     # device OOM surfacing through the collective error
@@ -379,11 +531,13 @@ def run(func):
                         "commit", e)
                     skip_sync = False
                     refresh_world = False  # membership unchanged
+                    escalated = False
                 except HostsUpdatedInterrupt as e:
                     get_logger().info(
                         "elastic: host membership changed — reinitializing")
                     skip_sync = e.skip_sync
                     refresh_world = True
+                    escalated = False  # confirmed change: a new version WILL come
                 reset_required = True
         finally:
             notification_manager.remove_listener(state)

@@ -369,6 +369,20 @@ def launch_elastic(args) -> int:
     port = rendezvous.start()
     addr = _routable_self_addr()
 
+    # Per-job coordinator base port when the whole (initial) world is
+    # local: avoids collisions with orphaned workers of previous jobs
+    # (launch.pick_coordinator_base_port; rank 0 = first local slot).
+    # Costs one extra discovery-script invocation at startup — accepted:
+    # the script must already be cheap enough for the periodic loop.
+    try:
+        from ..runner.launch import pick_coordinator_base_port, _is_local
+        initial_hosts = discovery.find_available_hosts_and_slots()
+        pick_coordinator_base_port(
+            bool(initial_hosts) and
+            all(_is_local(h) for h in initial_hosts))
+    except Exception as e:
+        get_logger().debug("coordinator port pick skipped: %s", e)
+
     from .launch_support import make_elastic_worker_fn
     driver = ElasticDriver(
         rendezvous, discovery, min_np, max_np,

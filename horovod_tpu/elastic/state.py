@@ -14,11 +14,16 @@ commit, sync broadcasts from the new coordinator (rank 0) after a reset.
 from __future__ import annotations
 
 import copy
+import os
+import pickle
+import socket
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
 from ..exceptions import HostsUpdatedInterrupt
+from .. import config as _config
 from .. import functions as _functions
 
 
@@ -29,10 +34,17 @@ class State:
     points (typically every N batches) and the elastic loop calls
     ``restore()`` after a failure or ``sync()`` after a topology change."""
 
-    def __init__(self, **kwargs):
+    def __init__(self, spill_dir: Optional[str] = None, **kwargs):
         self._reset_callbacks: List[Callable] = []
         self._host_messages = None  # set by the notification manager
         self._commit_seq = 0  # progress marker for the elastic retry bound
+        # Disk spill: survives ABRUPT peer death, which the in-memory commit
+        # cannot — a crashed peer FATALs every survivor's jax.distributed
+        # client (TF coordination-service error propagation), so the only
+        # copy of the last commit that outlives the process is one on disk.
+        # The respawned incarnation picks it up via load_spill().
+        self._spill_dir = spill_dir or os.environ.get(
+            "HVD_TPU_ELASTIC_SPILL_DIR")
 
     def register_reset_callbacks(self, callbacks) -> None:
         """Callbacks invoked after world reset (re-jit, rebuild data sharding
@@ -49,11 +61,104 @@ class State:
             self._host_messages.append((updated_hosts, update_res))
 
     def commit(self) -> None:
-        """Checkpoint to memory and check for host changes
-        (common/elastic.py State.commit)."""
+        """Checkpoint to memory (and to disk when spill is enabled) and
+        check for host changes (common/elastic.py State.commit)."""
         self.save()
         self._commit_seq = getattr(self, "_commit_seq", 0) + 1
+        self._spill()
         self.check_host_updates()
+
+    # Disk spill ------------------------------------------------------------
+    def _spill_path(self) -> Optional[str]:
+        """Spill file keyed by (hostname, local_rank): stable across a full
+        job restart even when global ranks are reshuffled by the new world
+        (the post-restart ``sync()`` broadcast from rank 0 makes whichever
+        copy the new rank 0 loaded authoritative)."""
+        if not getattr(self, "_spill_dir", None):
+            return None
+        host = os.environ.get(_config.HOROVOD_HOSTNAME, socket.gethostname())
+        local_rank = os.environ.get(_config.HOROVOD_LOCAL_RANK, "0")
+        return os.path.join(self._spill_dir, f"state-{host}-{local_rank}.pkl")
+
+    def _spill(self) -> None:
+        path = self._spill_path()
+        if path is None:
+            return
+        try:
+            data = self._spill_payload()
+        except NotImplementedError:
+            # Custom State subclasses written against the original
+            # save/restore/sync contract: degrade gracefully (warn once)
+            # instead of failing the first commit() mid-training.
+            if not getattr(self, "_spill_warned", False):
+                self._spill_warned = True
+                from ..utils import get_logger
+                get_logger().warning(
+                    "%s does not implement _spill_payload/"
+                    "_load_spill_payload; disk spill is disabled for it "
+                    "(implement both hooks to survive abrupt crashes)",
+                    type(self).__name__)
+            return
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            payload = {"seq": self._commit_seq, "data": data}
+            # Atomic publish: a crash mid-pickle leaves the previous
+            # commit's file intact (tmp + rename on the same filesystem).
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except Exception as e:
+            # A full/unwritable spill directory — or an unpicklable state
+            # attribute (PicklingError/TypeError) — must not kill the job
+            # the spill exists to harden: the in-memory commit remains
+            # valid, only crash-survival degrades.  Warn (throttled).
+            now = time.time()
+            if now - getattr(self, "_spill_err_ts", 0.0) > 60.0:
+                self._spill_err_ts = now
+                from ..utils import get_logger
+                get_logger().warning(
+                    "elastic spill to %s failed (%s); training continues "
+                    "but a crash now loses progress since the last good "
+                    "spill", path, e)
+
+    def load_spill(self) -> bool:
+        """Adopt a previous process incarnation's last on-disk commit if it
+        is AHEAD of this object's in-memory commit.  Returns True when state
+        was loaded (the caller should restore()/sync() afterwards).  Called
+        automatically at ``hvd.elastic.run`` entry."""
+        path = self._spill_path()
+        if path is None or not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception:
+            return False  # torn/corrupt file: fall back to in-memory state
+        if payload.get("seq", 0) <= getattr(self, "_commit_seq", 0):
+            return False
+        try:
+            self._load_spill_payload(payload["data"])
+        except NotImplementedError:
+            return False  # subclass without spill hooks (see _spill)
+        self._commit_seq = payload["seq"]
+        return True
+
+    def clear_spill(self) -> None:
+        """Remove the spill file (on successful training completion, so a
+        LATER job reusing the directory does not resurrect stale state)."""
+        path = self._spill_path()
+        if path is not None and os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _spill_payload(self) -> Any:
+        raise NotImplementedError
+
+    def _load_spill_payload(self, data: Any) -> None:
+        raise NotImplementedError
 
     def check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt when membership changed
@@ -84,11 +189,12 @@ class ObjectState(State):
     ObjectState): attributes set via kwargs, saved/restored by deep copy,
     synced by rank-0 object broadcast."""
 
-    def __init__(self, bcast_object=None, get_rank=None, **kwargs):
+    def __init__(self, bcast_object=None, get_rank=None, spill_dir=None,
+                 **kwargs):
         self._bcast_object = bcast_object or _functions.broadcast_object
         self._saved_state = dict(kwargs)
         self.__dict__.update(kwargs)
-        super().__init__()
+        super().__init__(spill_dir=spill_dir)
 
     def save(self) -> None:
         new_state = {}
@@ -106,19 +212,26 @@ class ObjectState(State):
             self.__dict__.update(
                 {k: copy.deepcopy(v) for k, v in synced.items()})
 
+    def _spill_payload(self):
+        return self._saved_state
+
+    def _load_spill_payload(self, data) -> None:
+        self._saved_state = data
+        self.restore()
+
 
 class ArrayState(State):
     """State for jax pytrees (params, optimizer state) — the TPU analog of
     TorchState's ModelStateHandler/OptimizerStateHandler
     (torch/elastic/state.py:27-130)."""
 
-    def __init__(self, **trees):
+    def __init__(self, spill_dir=None, **trees):
         self._trees: Dict[str, Any] = dict(trees)
         self._saved: Dict[str, Any] = {
             k: jax.device_get(v) for k, v in trees.items()}
         for k, v in trees.items():
             setattr(self, k, v)
-        super().__init__()
+        super().__init__(spill_dir=spill_dir)
 
     def save(self) -> None:
         """Commit to host memory (in-memory checkpoint, SURVEY.md §5.4)."""
@@ -137,6 +250,13 @@ class ArrayState(State):
             setattr(self, k, _functions.broadcast_variables(
                 getattr(self, k), root_rank=0))
 
+    def _spill_payload(self):
+        return self._saved  # host-side numpy pytrees: directly pickleable
+
+    def _load_spill_payload(self, data) -> None:
+        self._saved = data
+        self.restore()
+
 
 class TpuState(ObjectState):
     """Combined convenience state: jax pytrees + plain Python attributes.
@@ -145,12 +265,13 @@ class TpuState(ObjectState):
     the analog of hvd.elastic.TorchState(model, optimizer, epoch=..).
     """
 
-    def __init__(self, bcast_object=None, **kwargs):
+    def __init__(self, bcast_object=None, spill_dir=None, **kwargs):
         self._array_keys = [k for k, v in kwargs.items()
                             if _is_pytree_of_arrays(v)]
         self._object_keys = [k for k in kwargs if k not in self._array_keys]
         self._arrays_saved = {}
-        super().__init__(bcast_object=bcast_object, **kwargs)
+        super().__init__(bcast_object=bcast_object, spill_dir=spill_dir,
+                         **kwargs)
         self.save()
 
     def save(self) -> None:
@@ -175,6 +296,16 @@ class TpuState(ObjectState):
                 {k: getattr(self, k) for k in self._object_keys},
                 root_rank=0)
             self.__dict__.update(copy.deepcopy(synced))
+
+    def _spill_payload(self):
+        # _arrays_saved holds device_get'ed numpy pytrees; _saved_state
+        # holds deep copies of plain attributes — both pickleable as-is.
+        return {"arrays": self._arrays_saved, "objects": self._saved_state}
+
+    def _load_spill_payload(self, data) -> None:
+        self._arrays_saved = data["arrays"]
+        self._saved_state = data["objects"]
+        self.restore()
 
 
 def _is_pytree_of_arrays(v) -> bool:

@@ -51,8 +51,26 @@ class _KVHandler(BaseHTTPRequestHandler):
         self._empty(200)
 
     def do_GET(self):
+        key = self._key()
+        if key == "":
+            # Scope scan: GET /{scope} returns the whole scope as JSON
+            # {key: base64(value)} — one request where per-key polling
+            # would be O(keys) (e.g. the elastic init barrier reading
+            # every rank's presence each poll).
+            import base64
+            import json as _json
+            with self.server.cache_lock:
+                scope = dict(self.server.cache.get(self._scope(), {}))
+            body = _json.dumps({
+                k: base64.b64encode(v).decode("ascii")
+                for k, v in scope.items()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server.cache_lock:
-            value = self.server.cache.get(self._scope(), {}).get(self._key())
+            value = self.server.cache.get(self._scope(), {}).get(key)
         if value is None:
             self._empty(404)
             return
@@ -190,3 +208,12 @@ class KVStoreClient:
         status, _ = self._request("DELETE", f"/{scope}/{key}")
         if status >= 400 and status != 404:
             raise OSError(f"KV delete {scope}/{key} failed: HTTP {status}")
+
+    def scan(self, scope: str) -> dict:
+        """Fetch a whole scope in ONE request: {key: value-bytes}."""
+        import base64
+        status, data = self._request("GET", f"/{scope}")
+        if status >= 400:
+            raise OSError(f"KV scan {scope} failed: HTTP {status}")
+        return {k: base64.b64decode(v)
+                for k, v in json.loads(data or b"{}").items()}

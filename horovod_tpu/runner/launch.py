@@ -368,6 +368,7 @@ def _run_static(args, on_rendezvous=None) -> int:
         coord_addr = addr  # routable self-address when remotes exist
     else:
         coord_addr = coord_host
+    pick_coordinator_base_port(_is_local(coord_host))
     coordinator = f"{coord_addr}:{int(os.environ.get('HVD_TPU_COORD_PORT', 29400))}"
 
     base_env = {k: v for k, v in os.environ.items()}
@@ -430,6 +431,41 @@ def _run_elastic(args) -> int:
     """Elastic launch (launch.py:689): delegate to the elastic driver."""
     from ..elastic.driver import launch_elastic
     return launch_elastic(args)
+
+
+def pick_coordinator_base_port(coordinator_host_is_local: bool) -> None:
+    """Default the jax.distributed coordinator BASE port to a free one.
+
+    A fixed default (29400) collides across successive or concurrent jobs
+    on one host — e.g. orphaned workers of a killed launcher still bound
+    to the old job's coordinator ports livelock the next job's
+    registration.  Elastic world incarnations derive their ports from
+    this base (elastic.coordinator_port_for), so the whole derived range
+    moves with it.  An explicit HVD_TPU_COORD_PORT still wins (multi-host
+    jobs where remote firewalls need a pinned port).
+
+    Only applies when the coordinator (rank 0) runs on THIS host — the
+    bind probe says nothing about a remote rank-0 host's port space, so
+    multi-host jobs keep the pinned default."""
+    if os.environ.get("HVD_TPU_COORD_PORT") or not coordinator_host_is_local:
+        return
+    port = None
+    for _ in range(16):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("", 0))
+            cand = s.getsockname()[1]
+        finally:
+            s.close()
+        # Derived incarnation ports span [base, base+2000); keep the whole
+        # range inside the valid port space.
+        if cand <= 63500:
+            port = cand
+            break
+    if port is None:
+        import random
+        port = random.randint(20000, 40000)
+    os.environ["HVD_TPU_COORD_PORT"] = str(port)
 
 
 def _run(args) -> int:

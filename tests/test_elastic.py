@@ -655,3 +655,128 @@ def test_elastic_scale_down_then_up_end_to_end(tmp_path):
         first2 = sizes.index(2)
         assert all(s == 3 for s in sizes[:first2]), sizes
         assert int(processed) >= 240  # full epoch completed (with padding)
+
+
+# ---------------------------------------------------------------------------
+# Disk spill: elastic state surviving ABRUPT peer death (TODO.md parity gap —
+# a crashed peer FATALs survivors' jax.distributed clients, so the in-memory
+# commit dies with the process; the spill file is the copy that survives)
+# ---------------------------------------------------------------------------
+
+def test_state_spill_roundtrip(tmp_path, hvd8):
+    spill = str(tmp_path / "spill")
+    state = E.TpuState(spill_dir=spill,
+                       params={"w": jnp.ones((3,), jnp.float32)}, epoch=0)
+    state.params = {"w": state.params["w"] * 4}
+    state.epoch = 7
+    state.commit()
+    # A FRESH incarnation (same worker identity, new process) adopts the
+    # on-disk commit because it is ahead of its own seq 0.
+    fresh = E.TpuState(spill_dir=spill,
+                       params={"w": jnp.zeros((3,), jnp.float32)}, epoch=0)
+    assert fresh.load_spill() is True
+    assert fresh._commit_seq == 1
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 4 * np.ones(3))
+    assert fresh.epoch == 7
+    # The committing state itself must NOT re-adopt its own spill (not ahead).
+    assert state.load_spill() is False
+    # clear_spill removes the file; a later fresh state finds nothing.
+    state.clear_spill()
+    later = E.TpuState(spill_dir=spill,
+                       params={"w": jnp.zeros((3,), jnp.float32)}, epoch=0)
+    assert later.load_spill() is False
+
+
+def test_state_spill_torn_write_ignored(tmp_path, hvd8):
+    spill = str(tmp_path / "spill")
+    state = E.ObjectState(spill_dir=spill, step=3)
+    state.commit()
+    path = state._spill_path()
+    # Corrupt the published file: load must fall back to in-memory state
+    # (a torn write can only ever affect the .tmp, but guard the reader too).
+    with open(path, "wb") as f:
+        f.write(b"\x80garbage")
+    fresh = E.ObjectState(spill_dir=spill, step=0)
+    assert fresh.load_spill() is False
+    assert fresh.step == 0
+
+
+def test_state_spill_disabled_without_dir(hvd8):
+    state = E.ObjectState(step=1)
+    state.commit()  # no spill dir: must be a no-op, not an error
+    assert state._spill_path() is None
+    assert state.load_spill() is False
+
+
+CRASH_WORKER = """
+import jax
+jax.config.update('jax_platforms','cpu')
+import sys, os; sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd, jax.numpy as jnp
+hvd.init()
+state = hvd.elastic.TpuState(params={{"w": jnp.zeros((2,))}}, batch=0)
+seen = {{}}
+
+@hvd.elastic.run
+def train(state):
+    if "first_batch" not in seen:
+        seen["first_batch"] = state.batch
+    while state.batch < 10:
+        out = hvd.allreduce(jnp.ones((2,)), op=hvd.Sum, name="g")
+        state.params = {{"w": state.params["w"] + 1.0}}
+        state.batch += 1
+        if state.batch % 2 == 0:
+            state.commit()
+        if state.batch == 5 and hvd.rank() == 1 \\
+                and not os.path.exists({marker!r}):
+            open({marker!r}, "w").close()
+            os._exit(1)   # ABRUPT death: no exception, no graceful exit
+    return float(state.params["w"][0])
+
+w = train(state)
+print(f"rank{{hvd.rank()}} CRASHSURVIVED size={{hvd.size()}} "
+      f"batches={{state.batch}} w={{w}} first_batch={{seen['first_batch']}}",
+      flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_abrupt_crash_resumes_from_spill(tmp_path):
+    """TODO.md parity gap closed: rank 1 dies with os._exit (no graceful
+    path), survivors either recover in place or are FATALed by the
+    coordination service and respawned by the driver — in every outcome the
+    job completes with state continuity because commits were spilled to
+    disk.  The respawned incarnation must resume from the last commit
+    (batch 4), not from scratch."""
+    import subprocess
+    import sys
+    disc = tmp_path / "disc.sh"
+    disc.write_text("#!/bin/sh\necho localhost:2\n")
+    disc.chmod(0o755)
+    marker = str(tmp_path / "crashed.marker")
+    worker = tmp_path / "worker.py"
+    worker.write_text(CRASH_WORKER.format(repo=REPO, marker=marker))
+    env = dict(os.environ)
+    env["HVD_TPU_ELASTIC_SPILL_DIR"] = str(tmp_path / "spill")
+    env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "20"  # fast stall recovery
+    # A doomed survivor dies in the failed shutdown barrier; bound it so
+    # the respawn cycle converges inside the test budget.
+    env["HVD_TPU_DIST_SHUTDOWN_TIMEOUT_S"] = "10"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "2", "--max-np", "2",
+         "--host-discovery-script", str(disc),
+         "--blacklist-cooldown-range", "1", "3",
+         sys.executable, str(worker)],
+        cwd=REPO, capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-3000:]
+    import re as _re
+    done = _re.findall(
+        r"rank(\d) CRASHSURVIVED size=(\d) batches=(\d+) w=([0-9.]+) "
+        r"first_batch=(\d+)", proc.stdout)
+    assert len(done) == 2, proc.stdout[-4000:]
+    for rank_, size_, batches, w, first_batch in done:
+        assert int(size_) == 2 and int(batches) == 10 and float(w) == 10.0
+    # At least the crashed worker's replacement resumed from the on-disk
+    # commit (batch 4), proving the spill — not a from-scratch restart.
+    assert any(int(fb) == 4 for *_, fb in done), done
