@@ -109,12 +109,68 @@ def _reduce_grad_leaf(l, op, compression, prescale, postscale, process_set):
                           process_set=process_set)
 
 
+def _reduce_multi_axis_leaf(l, op, prescale, postscale, reduce_axes):
+    """Reduce one gradient leaf over a SUBSET of a multi-axis mesh's axes
+    (the dp×sp / dp×tp case the reference never reaches — SURVEY.md §2.3).
+
+    Semantics: psum over whichever of ``reduce_axes`` the leaf is still
+    varying on (vma); leaves the shard_map transpose already summed (grads
+    of replicated params arrive invariant) are not re-summed.  AVERAGE
+    divides by the TOTAL data-parallel degree — the product of all
+    reduce_axes sizes — uniformly for both cases, so replicated-parameter
+    gradients come out as the global mean regardless of which axes XLA
+    pre-reduced."""
+    vma = getattr(jax.typeof(l), "vma", frozenset())
+    from .ops import collective_ops as C
+    l = C._apply_scale(l, prescale)
+    varying = tuple(a for a in reduce_axes if a in vma)
+    if varying:
+        l = jax.lax.psum(l, varying)
+    if op == ReduceOp.AVERAGE:
+        n = 1
+        for a in reduce_axes:
+            n *= jax.lax.axis_size(a)
+        l = l / n
+    return C._apply_scale(l, postscale)
+
+
 def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
-                    groups=None):
+                    groups=None, reduce_axes=None):
     """Tree-map allreduce; ``groups`` (list of param-name buckets) reproduces
     the reference's `groups` option (torch/optimizer.py grouped allreduce) —
     under jit the grouping is advisory since XLA's combiner re-buckets, so we
-    lower each group through grouped_allreduce for eager parity."""
+    lower each group through grouped_allreduce for eager parity.
+    ``reduce_axes`` switches to multi-axis mesh reduction (2-D sugar)."""
+    if reduce_axes is not None:
+        axes = tuple(reduce_axes)
+        # Leaf-independent validation, once per tree (not once per leaf).
+        for a in axes:
+            try:
+                jax.lax.axis_size(a)
+            except NameError:
+                raise ValueError(
+                    f"reduce_axes={axes}: axis {a!r} is not bound — "
+                    f"multi-axis gradient reduction only works inside "
+                    f"shard_map over a mesh carrying those axes")
+        # Under shard_map(check_vma=False) vma tracking is OFF: every
+        # value types as frozenset() and would be treated as pre-reduced,
+        # silently skipping the psum.  Probe with pvary — if even an
+        # explicitly varying value carries no vma, tracking is off and we
+        # cannot tell local from pre-summed gradients; fail loudly rather
+        # than diverge quietly.
+        probe = jax.lax.pvary(jnp.zeros(()), axes)
+        if not getattr(jax.typeof(probe), "vma", frozenset()):
+            raise ValueError(
+                "reduce_axes requires varying-manual-axes tracking to "
+                "tell local gradients from pre-reduced ones; use "
+                "shard_map(..., check_vma=True) (the default) with "
+                "DistributedOptimizer(reduce_axes=...)")
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(
+                f"reduce_axes supports Sum/Average gradients, got {op!r}")
+        return jax.tree_util.tree_map(
+            lambda l: _reduce_multi_axis_leaf(l, op, prescale, postscale,
+                                              axes), grads)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if groups:
         axis = _axis_name()
@@ -206,7 +262,8 @@ def distributed_gradient_transformation(
         compression=Compression.none,
         gradient_predivide_factor: float = 1.0,
         process_set: ProcessSet = global_process_set,
-        groups=None):
+        groups=None,
+        reduce_axes: Optional[Sequence[str]] = None):
     """The bare allreduce-gradients transformation (composable with any
     optax chain).  Equivalent of wrapping compute_gradients
     (tensorflow/__init__.py:896 DistributedOptimizer._compute_gradients).
@@ -234,7 +291,8 @@ def distributed_gradient_transformation(
     def update_fn(updates, state, params=None):
         del params
         reduced = _allreduce_tree(updates, op, compression, prescale,
-                                  postscale, process_set, groups)
+                                  postscale, process_set, groups,
+                                  reduce_axes=reduce_axes)
         return reduced, state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -248,7 +306,8 @@ def DistributedOptimizer(optimizer,
                          gradient_predivide_factor: float = 1.0,
                          num_groups: int = 0,
                          groups=None,
-                         process_set: ProcessSet = global_process_set):
+                         process_set: ProcessSet = global_process_set,
+                         reduce_axes: Optional[Sequence[str]] = None):
     """Wrap an optax optimizer with Horovod-style gradient reduction
     (hvd.DistributedOptimizer, torch/optimizer.py:36 /
     tensorflow/__init__.py:896).
@@ -269,15 +328,35 @@ def DistributedOptimizer(optimizer,
     (_DistributedAdasumOptimizer, torch/optimizer.py:345: delta = lr*grad is
     proportional to grad); for adaptive optimizers prefer reducing deltas
     explicitly via ``adasum_delta_step``.
+
+    2-D+ meshes: ``reduce_axes=("dp", "sp")`` makes the gradient reduction
+    span exactly those mesh axes inside a multi-axis ``shard_map`` (e.g.
+    data-parallel × sequence-parallel training): leaves still varying on a
+    listed axis are psum'd over it, pre-reduced leaves are not re-summed,
+    and Average divides by the product of the listed axis sizes.  Beyond
+    the reference's single-communicator scope; see docs/
+    sequence_parallelism.md.
     """
     if optax is None:
         raise ImportError("optax is required for the optimizer layer")
     if num_groups and groups is None:
         groups = num_groups
+    if reduce_axes is not None:
+        if process_set is not global_process_set:
+            raise ValueError("reduce_axes and process_set are mutually "
+                             "exclusive (subset semantics live on the 1-D "
+                             "framework axis)")
+        if compression is not Compression.none or groups is not None:
+            # In-trace multi-axis psum has no compression/grouping stage;
+            # silently ignoring these options would let a user believe
+            # fp16-compressed or bucketed reduction is active.
+            raise ValueError("compression/groups are not supported with "
+                             "reduce_axes (XLA fuses and buckets in-trace "
+                             "collectives itself)")
     allreduce_t = distributed_gradient_transformation(
         op=op, compression=compression,
         gradient_predivide_factor=gradient_predivide_factor,
-        process_set=process_set, groups=groups)
+        process_set=process_set, groups=groups, reduce_axes=reduce_axes)
     n = max(1, int(backward_passes_per_step))
 
     if n == 1:

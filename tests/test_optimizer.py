@@ -248,3 +248,79 @@ def test_partial_distributed_optimizer(hvd8):
     # local: each slot keeps its own gradient
     for r in range(N):
         np.testing.assert_allclose(np.asarray(local[r]), -arr[r], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2-D mesh sugar: reduce_axes spans exactly the listed mesh axes
+# ---------------------------------------------------------------------------
+
+def test_reduce_axes_2d_mesh_average():
+    """DistributedOptimizer(reduce_axes=('dp','sp')) inside a dp×sp
+    shard_map: varying grads are averaged over BOTH axes; pre-reduced
+    (invariant) grads are normalized, not re-summed."""
+    import jax
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    import horovod_tpu as hvd
+
+    dp, sp = 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:dp * sp]).reshape(dp, sp),
+                ("dp", "sp"))
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), reduce_axes=("dp", "sp"))
+    g = jnp.asarray(np.random.RandomState(3).randn(dp * sp, 5)
+                    .astype(np.float32))
+    params = {"w": jnp.zeros((5,))}
+
+    def body(gr):
+        # gr: [1, 5] local shard (dim0 split over BOTH axes) -> a per-shard
+        # VARYING gradient
+        state = opt.init(params)
+        updates, _ = opt.update({"w": gr[0]}, state, params)
+        return jax.lax.pmean(jax.lax.pmean(updates["w"], "sp"), "dp")
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(("dp", "sp")),),
+        out_specs=P()))(g)
+    np.testing.assert_allclose(np.asarray(out), -np.asarray(g).mean(0),
+                               rtol=1e-5)
+
+
+def test_reduce_axes_invariant_leaf_normalized():
+    """A gradient that the shard_map transpose already globally summed
+    (replicated parameter) must be divided by dp*sp, not psum'd again."""
+    import jax
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    import horovod_tpu as hvd
+
+    dp, sp = 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:dp * sp]).reshape(dp, sp),
+                ("dp", "sp"))
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), reduce_axes=("dp", "sp"))
+    x = jnp.asarray(np.random.RandomState(5).randn(dp * sp, 3)
+                    .astype(np.float32))
+    w0 = jnp.ones((3,))
+
+    def body(w, xb):
+        def loss(p):
+            return jnp.sum(p * xb[0])   # per-shard loss on the local row
+        g = jax.grad(loss)(w)        # transpose pre-sums over ALL shards
+        state = opt.init(w)
+        updates, _ = opt.update(g, state, w)
+        return updates
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(("dp", "sp"))),
+        out_specs=P()))(w0, x)
+    # sum of per-shard grads (= sum of rows) averaged over dp*sp shards
+    np.testing.assert_allclose(np.asarray(out),
+                               -np.asarray(x).mean(0), rtol=1e-5)
+
+
+def test_reduce_axes_outside_mesh_raises():
+    import optax
+    import horovod_tpu as hvd
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), reduce_axes=("dp",))
+    with pytest.raises(ValueError, match="not bound"):
+        opt.update({"w": jnp.ones((2,))}, opt.init({"w": jnp.ones((2,))}),
+                   {"w": jnp.ones((2,))})
