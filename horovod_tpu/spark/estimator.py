@@ -358,11 +358,56 @@ class HorovodTpuEstimator:
                               store=store)
 
 
+def _append_predictions(model, params, feature_cols, outs, pdf):
+    """Predict one pandas frame and append ``<label>__output`` columns —
+    the single definition shared by distributed (mapInPandas) and
+    in-process transform so the two paths cannot diverge."""
+    import numpy as np
+    import jax.numpy as jnp
+    pdf = pdf.copy()
+    if len(pdf) == 0:
+        # Empty partitions are routine after filters/repartitions; emit
+        # the frame with empty output columns, matching schema.
+        for c in outs:
+            pdf[c] = []
+        return pdf
+    cols = {c: list(pdf[c]) for c in feature_cols}
+    X = _columns_to_array(cols, feature_cols)
+    pred = np.asarray(model.apply(params, jnp.asarray(X)))
+    if len(outs) == 1:
+        pdf[outs[0]] = list(pred) if pred.ndim > 1 else pred
+    else:
+        for i, c in enumerate(outs):
+            pdf[c] = pred[..., i]
+    return pdf
+
+
+def _transform_partition(payload: bytes, frames):
+    """Executor-side batch predictor for ``TpuTransformer.transform`` on a
+    pyspark DataFrame (the mapInPandas UDF body, factored out so the logic
+    is unit-testable without a Spark cluster).  ``payload`` is a
+    cloudpickled {model, params (host copies), feature_cols, label_cols};
+    yields each incoming pandas frame with ``<label>__output`` columns
+    appended.  Reference: HorovodModel.transform's pandas-UDF per-partition
+    prediction (spark/torch/estimator.py, keras/estimator.py)."""
+    import os
+    # Executors have no accelerator claim; force the CPU backend before
+    # jax initializes (a worker trying to grab the TPU relay would fail).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import cloudpickle
+    d = cloudpickle.loads(payload)
+    outs = [f"{c}__output" for c in d["label_cols"]]
+    for pdf in frames:
+        yield _append_predictions(d["model"], d["params"],
+                                  d["feature_cols"], outs, pdf)
+
+
 class TpuTransformer:
     """Trained-model Transformer (spark/common/estimator.py
     HorovodModel.transform analog): adds ``<label>__output`` prediction
     columns.  Accepts a pandas or pyspark DataFrame; pyspark input is
-    predicted on the driver and returned as a pyspark DataFrame."""
+    predicted DISTRIBUTED on the executors via ``mapInPandas`` (the
+    reference's pandas-UDF pattern), pandas input on the caller."""
 
     def __init__(self, model, params, feature_cols, label_cols,
                  history=None, run_id=None, store=None):
@@ -381,28 +426,50 @@ class TpuTransformer:
         import jax.numpy as jnp
         return self.model.apply(self.params, jnp.asarray(X))
 
+    def _udf_payload(self) -> bytes:
+        import cloudpickle
+        import jax
+        return cloudpickle.dumps({
+            "model": self.model, "params": jax.device_get(self.params),
+            "feature_cols": self.feature_cols,
+            "label_cols": self.label_cols})
+
     def transform(self, df):
         import numpy as np
-        spark_session = None
         if _is_spark_df(df):
-            spark_session = df.sparkSession
-            pdf = df.toPandas()
-        else:
-            import pandas as pd
-            pdf = df if isinstance(df, pd.DataFrame) else pd.DataFrame(df)
-            pdf = pdf.copy()
-        cols = {c: list(pdf[c]) for c in self.feature_cols}
-        X = _columns_to_array(cols, self.feature_cols)
-        pred = np.asarray(self.predict(X))
-        outs = self.output_cols()
-        if len(outs) == 1:
-            pdf[outs[0]] = list(pred) if pred.ndim > 1 else pred
-        else:
-            for i, c in enumerate(outs):
-                pdf[c] = pred[..., i]
-        if spark_session is not None:
-            return spark_session.createDataFrame(pdf)
-        return pdf
+            # DISTRIBUTED inference: each executor partition predicts via
+            # _transform_partition (mapInPandas), never funneling rows
+            # through the driver.  The output schema extends the input with
+            # one column per label; its Spark type is inferred from a
+            # one-row driver-side prediction (array column for vector
+            # outputs, double for scalars).
+            from pyspark.sql.types import (
+                ArrayType, DoubleType, StructField, StructType)
+            sample = df.limit(1).toPandas()
+            if len(sample) == 0:
+                # Empty DataFrame: no row to infer the vector-vs-scalar
+                # output shape from; default to scalar columns.  Caveat: a
+                # vector-output model's empty transform then has DoubleType
+                # where a non-empty one has ArrayType — unioning the two
+                # needs an explicit cast (unknowable here without a row).
+                out_type = DoubleType()
+            else:
+                scols = {c: list(sample[c]) for c in self.feature_cols}
+                spred = np.asarray(self.predict(
+                    _columns_to_array(scols, self.feature_cols)))
+                out_type = ArrayType(DoubleType()) if spred.ndim > 1 \
+                    and len(self.output_cols()) == 1 else DoubleType()
+            schema = StructType(list(df.schema.fields) + [
+                StructField(c, out_type, True) for c in self.output_cols()])
+            payload = self._udf_payload()
+            return df.mapInPandas(
+                lambda frames: _transform_partition(payload, frames),
+                schema=schema)
+        import pandas as pd
+        pdf = df if isinstance(df, pd.DataFrame) else pd.DataFrame(df)
+        return _append_predictions(self.model, self.params,
+                                   self.feature_cols, self.output_cols(),
+                                   pdf)
 
     # -- persistence (Spark ML write().save analog) -------------------------
 

@@ -154,3 +154,32 @@ def test_estimator_validation_column(tmp_path):
     model = est.fit(pd.DataFrame(data))
     assert all("val_loss" in h for h in est.history)
     assert model.run_id is not None
+
+
+def test_transform_partition_distributed_udf():
+    """The mapInPandas UDF body (_transform_partition) predicts per
+    incoming pandas frame with only the cloudpickled payload — the
+    distributed-inference path for pyspark DataFrames, testable without a
+    cluster (the reference mocks Spark the same way, test/single/
+    test_spark.py)."""
+    import pandas as pd
+    import jax.numpy as jnp
+    from horovod_tpu.models import create_mlp
+    from horovod_tpu.spark.estimator import (TpuTransformer,
+                                             _transform_partition)
+    import jax
+
+    model = create_mlp((6, 3))
+    X0 = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(X0[:1]))
+    tf = TpuTransformer(model=model, params=params,
+                        feature_cols=["features"], label_cols=["y"])
+    frames = [pd.DataFrame({"features": list(X0[:2]), "y": [0, 1]}),
+              pd.DataFrame({"features": list(X0[2:]), "y": [2, 0]})]
+    out = list(_transform_partition(tf._udf_payload(), iter(frames)))
+    assert len(out) == 2
+    expected = np.asarray(model.apply(params, jnp.asarray(X0)))
+    got = np.concatenate([np.stack(list(f["y__output"])) for f in out])
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+    # Input columns survive alongside the appended output column.
+    assert list(out[0].columns) == ["features", "y", "y__output"]
