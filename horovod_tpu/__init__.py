@@ -79,4 +79,5 @@ from .process_sets import (  # noqa: F401
 
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, CollectiveRejectedError,
+    RendezvousUnreachableError,
 )

@@ -23,6 +23,7 @@ for shapes seen before.
 
 from __future__ import annotations
 
+import errno
 import functools
 import json
 import os
@@ -31,7 +32,8 @@ import threading
 import time
 from typing import List, Optional
 
-from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                          RendezvousUnreachableError)
 from ..utils import get_logger
 from .. import config as _config
 from .state import State, ObjectState, ArrayState, TpuState  # noqa: F401
@@ -125,6 +127,48 @@ class WorkerNotificationManager:
 notification_manager = WorkerNotificationManager()
 
 
+class _RendezvousLiveness:
+    """Latches sustained transport-dead signals from the launcher's KV
+    store and raises ``RendezvousUnreachableError`` after
+    ``HVD_TPU_RENDEZVOUS_DEAD_S`` (default 30 s) without one successful
+    request.  Dead signals are refused/reset connections, connect/read
+    timeouts, and host/network-unreachable errnos — a launcher process
+    death (RST) and a launcher HOST death (preempted VM, partition: no
+    RST, just timeouts) both qualify.  HTTP-status ``OSError``s raised by
+    the client for >=400 responses do NOT: the server answered, so it is
+    alive.  Polling loops call ``ok()`` after any successful request and
+    ``note(e)`` in their retry handler."""
+
+    _DEAD_ERRNOS = {errno.EHOSTUNREACH, errno.ENETUNREACH,
+                    errno.ECONNABORTED}
+
+    def __init__(self, addr, port):
+        self.addr, self.port = addr, port
+        self.window = float(
+            os.environ.get("HVD_TPU_RENDEZVOUS_DEAD_S", "30"))
+        self._since = None
+
+    def ok(self) -> None:
+        self._since = None
+
+    def note(self, e: BaseException) -> bool:
+        """Record an error; True if it was a transport-dead signal.
+        Raises RendezvousUnreachableError once signals have been sustained
+        for the window."""
+        dead = isinstance(e, (ConnectionRefusedError, ConnectionResetError,
+                              TimeoutError)) or \
+            (isinstance(e, OSError) and e.errno in self._DEAD_ERRNOS)
+        if not dead:
+            return False
+        now = time.monotonic()  # fatal verdict: immune to clock steps
+        self._since = self._since or now
+        if now - self._since > self.window:
+            raise RendezvousUnreachableError(
+                f"rendezvous {self.addr}:{self.port} unreachable for "
+                f"{self.window:.0f}s — launcher presumed dead") from e
+        return True
+
+
 def _refresh_world_from_rendezvous(allow_same_world: bool = False) -> str:
     """After a reset, fetch this worker's new slot record keyed by
     (hostname, local_rank) from the rendezvous KV store and refresh the
@@ -160,9 +204,11 @@ def _refresh_world_from_rendezvous(allow_same_world: bool = False) -> str:
     same_world_after = time.time() + float(
         os.environ.get("HVD_TPU_SAME_WORLD_FALLBACK_S", "20"))
     scaled_out_since = None
+    liveness = _RendezvousLiveness(addr, port)
     while time.time() < deadline:
         try:
             world_raw = client.get("rendezvous", "world")
+            liveness.ok()
             world = json.loads(world_raw) if world_raw else {"version": 0}
             if allow_same_world and time.time() > same_world_after and \
                     world.get("version", 0) == last_version:
@@ -211,6 +257,10 @@ def _refresh_world_from_rendezvous(allow_same_world: bool = False) -> str:
         except SystemExit:
             raise
         except Exception as e:
+            # A dead launcher means no world to rejoin: fail fast rather
+            # than polling out the full elastic timeout (note() raises
+            # RendezvousUnreachableError on sustained transport death).
+            liveness.note(e)
             get_logger().debug("rendezvous refresh retry: %s", e)
         time.sleep(0.5)
     raise HorovodInternalError(
@@ -261,6 +311,7 @@ def _await_world_at_init_barrier() -> None:
     deadline = time.time() + float(
         os.environ.get(_config.HOROVOD_ELASTIC_TIMEOUT, "600"))
     announced = None  # (version, c) last published
+    liveness = _RendezvousLiveness(addr, port)
 
     def _set_gen(w: int, c: int) -> None:
         os.environ["HVD_TPU_NEGOTIATION_GEN"] = f"{w}.{c}"
@@ -277,12 +328,13 @@ def _await_world_at_init_barrier() -> None:
         size = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
         if size <= 1:
             return  # no peers to meet
-        if announced != (my_version, my_c):
-            client.put("initbar", f"{my_version}/{rank}",
-                       str(my_c).encode())
-            announced = (my_version, my_c)
         try:
+            if announced != (my_version, my_c):
+                client.put("initbar", f"{my_version}/{rank}",
+                           str(my_c).encode())
+                announced = (my_version, my_c)
             raw = client.get("rendezvous", "world")
+            liveness.ok()
             world = json.loads(raw) if raw else {}
             if world.get("version", my_version) > my_version:
                 # Spawn world superseded: adopt the new world's slot for
@@ -310,6 +362,7 @@ def _await_world_at_init_barrier() -> None:
         except HorovodInternalError:
             raise
         except Exception as e:
+            liveness.note(e)
             get_logger().debug("init barrier poll failed: %s", e)
         time.sleep(0.2)
     raise HorovodInternalError(
@@ -476,6 +529,8 @@ def run(func):
                         if not isinstance(e, (HorovodInternalError,
                                               _jax.errors.JaxRuntimeError)):
                             raise
+                        if isinstance(e, RendezvousUnreachableError):
+                            raise  # no launcher → no world to rejoin
                         reset_failures += 1
                         if reset_failures >= 6:
                             # A dead launcher/rendezvous makes every reset

@@ -25,6 +25,14 @@ class CollectiveRejectedError(HorovodInternalError):
     servicing, whereas a local timeout must propagate."""
 
 
+class RendezvousUnreachableError(HorovodInternalError):
+    """The launcher's rendezvous KV server refused connections for a
+    sustained window — the launcher is presumed dead.  Unlike a transient
+    reset failure this is NOT retried: without a rendezvous there is no
+    world to rejoin, so the worker terminates promptly instead of polling
+    out the full elastic timeout."""
+
+
 class HostsUpdatedInterrupt(Exception):
     """Raised when the set of participating hosts changes mid-training.
 

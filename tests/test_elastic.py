@@ -269,6 +269,50 @@ def test_driver_reset_limit_stops():
         rdv.stop()
 
 
+def test_refresh_world_fails_fast_when_rendezvous_dead(monkeypatch):
+    """A dead launcher (KV port refusing connections) must surface as
+    RendezvousUnreachableError within HVD_TPU_RENDEZVOUS_DEAD_S, not poll
+    out the full HOROVOD_ELASTIC_TIMEOUT (the round-2 leaked-worker bug:
+    orphans survived the launcher by 20+ minutes)."""
+    import socket as _socket
+    from horovod_tpu import config as _cfg
+    from horovod_tpu.exceptions import RendezvousUnreachableError
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    free_port = s.getsockname()[1]
+    s.close()  # nothing listens on free_port now
+    monkeypatch.setenv(_cfg.HOROVOD_RENDEZVOUS_ADDR, "127.0.0.1")
+    monkeypatch.setenv(_cfg.HOROVOD_RENDEZVOUS_PORT, str(free_port))
+    monkeypatch.setenv(_cfg.HOROVOD_ELASTIC_TIMEOUT, "120")
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_DEAD_S", "1")
+    t0 = time.time()
+    with pytest.raises(RendezvousUnreachableError):
+        E._refresh_world_from_rendezvous()
+    assert time.time() - t0 < 30  # fast-fail, nowhere near 120 s
+
+
+def test_init_barrier_fails_fast_when_rendezvous_dead(monkeypatch):
+    """Same dead-launcher fast-fail on the pre-init KV barrier path."""
+    import socket as _socket
+    from horovod_tpu import config as _cfg
+    from horovod_tpu.exceptions import RendezvousUnreachableError
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    free_port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv(_cfg.HOROVOD_RENDEZVOUS_ADDR, "127.0.0.1")
+    monkeypatch.setenv(_cfg.HOROVOD_RENDEZVOUS_PORT, str(free_port))
+    monkeypatch.setenv(_cfg.HOROVOD_ELASTIC_TIMEOUT, "120")
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_DEAD_S", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+    monkeypatch.setenv(_cfg.HOROVOD_RANK, "0")
+    monkeypatch.setenv(_cfg.HOROVOD_SIZE, "2")  # >1 so the barrier polls
+    t0 = time.time()
+    with pytest.raises(RendezvousUnreachableError):
+        E._await_world_at_init_barrier()
+    assert time.time() - t0 < 30
+
+
 def test_driver_waits_for_min_slots_timeout():
     driver, rdv, disc = _make_driver({}, 2, 2, timeout=0.5)
     with pytest.raises(RuntimeError, match="Timed out waiting"):
@@ -634,12 +678,16 @@ def test_elastic_scale_down_then_up_end_to_end(tmp_path):
     t.start()
     env = dict(os.environ)
     env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "20"  # fast stall recovery
+    # Worker-side deadlines must sit WELL inside the subprocess budget:
+    # under full-suite CPU load, gloo re-inits and negotiation rounds run
+    # several times slower than in isolation (this test: 53 s alone).
+    env["HOROVOD_ELASTIC_TIMEOUT"] = "150"
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner.launch",
          "--min-np", "2", "--max-np", "3",
          "--host-discovery-script", str(disc),
          sys.executable, str(worker)],
-        cwd=REPO, capture_output=True, text=True, timeout=300, env=env)
+        cwd=REPO, capture_output=True, text=True, timeout=480, env=env)
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     import re as _re
     done = _re.findall(r"SDWORKER done rank=(\d) size=(\d) "
