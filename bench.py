@@ -51,15 +51,19 @@ def bench_bert():
     number, so vs_baseline reports per-chip samples/sec directly."""
     import contextlib
     from examples.bert_pretraining import main as bert_main
+    bs = os.environ.get("BENCH_BERT_BATCH", "32")
     with contextlib.redirect_stdout(sys.stderr):  # keep stdout = 1 JSON line
         losses, samples_s = bert_main(["--size", "large", "--steps", "10",
-                                       "--batch-per-slot", "8",
+                                       "--batch-per-slot", bs,
                                        "--seq-len", "128"])
     print(json.dumps({
         "metric": "bert_large_mlm_samples_per_sec",
         "value": round(samples_s, 2),
         "unit": "samples/sec",
         "vs_baseline": round(samples_s / hvd.num_slots(), 3),
+        # Not comparable across configs: round-1/2 records used bs 8 with
+        # remat on; this records the actual measurement setup.
+        "config": f"bs{bs}/slot seq128 accum2 no-remat",
     }))
 
 
@@ -102,7 +106,9 @@ def main():
         return
     hvd.init()
     nslots = hvd.num_slots()
-    model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16, sync_bn=True)
+    model = create_resnet50(
+        num_classes=1000, dtype=jnp.bfloat16, sync_bn=True,
+        fast_stem=os.environ.get("BENCH_FAST_STEM", "1") == "1")
     rng = jax.random.PRNGKey(0)
     batch = BATCH_PER_CHIP * nslots
 

@@ -26,6 +26,129 @@ import flax.linen as nn
 ModuleDef = Any
 
 
+def _space_to_depth(x):
+    """(N, H, W, C) -> (N, H/2, W/2, 4C); depth flattened as (di, dj, c)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
+class SpaceToDepthStem(nn.Module):
+    """The stem's 7x7/stride-2 conv re-indexed as a 4x4/stride-1 conv on
+    2x2 space-to-depth input (the MLPerf TPU ResNet trick).
+
+    Identical math: y[p,q] = sum_{u,v} w[u,v] x[2p+u-2, 2q+v-2] becomes,
+    with u = 2A + di (A in 0..3, di in 0..1) and s2d rows m holding
+    original rows 2m+di, a 4-tap conv over m = p-1..p+2, i.e. kernel 4,
+    stride 1, padding (1, 2).  The kernel is stored in the ORIGINAL
+    (7, 7, C, F) layout (checkpoint-compatible with the naive conv),
+    zero-padded to 8x8 and regrouped per call — 12K floats, free next to
+    the conv itself.  Why bother: the naive stem conv runs at 224^2
+    spatial with 3 input channels — the worst MXU shape in the net and
+    the largest single fusion in the round-2 profile; the re-indexed conv
+    runs at 112^2 with 12 channels."""
+    features: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.shape[1] % 2 or x.shape[2] % 2:
+            raise ValueError("SpaceToDepthStem requires even H and W, got "
+                             f"{x.shape}; use the naive stem (fast_stem="
+                             "False) for odd extents")
+        c = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, c, self.features), jnp.float32)
+        k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        k = k.reshape(4, 2, 4, 2, c, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                  self.features)
+        return jax.lax.conv_general_dilated(
+            _space_to_depth(x).astype(self.dtype), k.astype(self.dtype),
+            window_strides=(1, 1), padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _max_pool_3x3s2(x):
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+
+@jax.custom_vjp
+def max_pool_eq_grad(x):
+    """3x3/stride-2 SAME max pool whose backward pass is written as
+    elementwise equality gathers instead of XLA's ``select_and_scatter``
+    (1.4 ms/step in the round-2 ResNet profile; no MXU, poorly tiled on
+    TPU).  Tie semantics differ deliberately: ``select_and_scatter``
+    routes the gradient to the FIRST max of a window, this routes 1/n to
+    EACH of n tied maxima — the gradient sum is preserved, which is the
+    property training cares about."""
+    return _max_pool_3x3s2(x)
+
+
+def _mp_fwd(x):
+    if x.shape[1] % 2 or x.shape[2] % 2:
+        # The parity-gather backward assumes SAME padding (0, 1) per
+        # spatial dim, which holds only for even extents.
+        raise ValueError("max_pool_eq_grad requires even H and W, got "
+                         f"{x.shape}; use nn.max_pool for odd extents")
+    y = _max_pool_3x3s2(x)
+    return y, (x, y)
+
+
+def _mp_bwd(res, g):
+    x, y = res
+    n, h, w, c = x.shape
+    oh, ow = y.shape[1], y.shape[2]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    # SAME for k=3, s=2, even H: pad lo 0, hi 1.
+    xp = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)), constant_values=neg)
+
+    # Tie counts per window, at output resolution (padded -inf never
+    # equals y: every window contains at least one real element).
+    cnt = jnp.zeros(y.shape, jnp.float32)
+    for u in range(3):
+        for v in range(3):
+            win = jax.lax.slice(xp, (0, u, v, 0),
+                                (n, u + 2 * oh - 1, v + 2 * ow - 1, c),
+                                (1, 2, 2, 1))
+            cnt = cnt + (win == y).astype(jnp.float32)
+    gn = g.astype(jnp.float32) / cnt
+
+    def row_gathers(a):
+        """a at output rows -> (A, B) at input rows: A[i] = a[i//2]
+        (valid for all i: window floor(i/2) always covers row i),
+        B[i] = a[i//2 - 1] (covers row i only for even i >= 2)."""
+        rep = jnp.repeat(a, 2, axis=1)[:, :h]
+        shifted = jnp.pad(rep, ((0, 0), (2, 0), (0, 0), (0, 0)))[:, :h]
+        return rep, shifted
+
+    def col_gathers(a):
+        rep = jnp.repeat(a, 2, axis=2)[:, :, :w]
+        shifted = jnp.pad(rep, ((0, 0), (0, 0), (2, 0), (0, 0)))[:, :, :w]
+        return rep, shifted
+
+    row_even = (jnp.arange(h) % 2 == 0) & (jnp.arange(h) >= 2)
+    col_even = (jnp.arange(w) % 2 == 0) & (jnp.arange(w) >= 2)
+    row_masks = (jnp.ones(h, bool), row_even)
+    col_masks = (jnp.ones(w, bool), col_even)
+
+    grad = jnp.zeros(x.shape, jnp.float32)
+    ga_rows, gy_rows = row_gathers(gn), row_gathers(y)
+    for ri in range(2):
+        g_r, y_r = ga_rows[ri], gy_rows[ri]
+        g_rc, y_rc = col_gathers(g_r), col_gathers(y_r)
+        for ci in range(2):
+            mask = (row_masks[ri][None, :, None, None]
+                    & col_masks[ci][None, None, :, None])
+            eq = (x == y_rc[ci]) & mask
+            grad = grad + jnp.where(eq, g_rc[ci], 0.0)
+    return (grad.astype(x.dtype),)
+
+
+max_pool_eq_grad.defvjp(_mp_fwd, _mp_bwd)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int] = (1, 1)
@@ -59,6 +182,8 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     axis_name: Optional[str] = None  # set to "hvd" for sync batch norm
     block_cls: ModuleDef = BottleneckBlock
+    s2d_stem: bool = False       # space-to-depth re-indexed stem conv
+    eq_pool_grad: bool = False   # maxpool backward without select_and_scatter
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -67,10 +192,21 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
                        axis_name=self.axis_name if train else None)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.s2d_stem:
+            x = SpaceToDepthStem(self.num_filters, dtype=self.dtype,
+                                 name="conv_init")(x)
+        else:
+            # use_bias=False: the bias feeds straight into BN, which
+            # subtracts it right back out (and it kept the param tree
+            # from matching SpaceToDepthStem's).
+            x = conv(self.num_filters, (7, 7), (2, 2), use_bias=False,
+                     name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if self.eq_pool_grad:
+            x = max_pool_eq_grad(x)
+        else:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -87,6 +223,9 @@ ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
 
 
 def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
-                    sync_bn: bool = False):
+                    sync_bn: bool = False, fast_stem: bool = False):
+    """``fast_stem=True`` enables the two TPU stem optimizations
+    (SpaceToDepthStem + max_pool_eq_grad) — same math, same param tree."""
     return ResNet50(num_classes=num_classes, dtype=dtype,
-                    axis_name="hvd" if sync_bn else None)
+                    axis_name="hvd" if sync_bn else None,
+                    s2d_stem=fast_stem, eq_pool_grad=fast_stem)

@@ -159,3 +159,91 @@ def test_ring_striped_transformer_matches_dense(hvd8):
     np.testing.assert_allclose(
         np.asarray(unstripe_sequence(sp_logits, N)),
         np.asarray(dense_logits), rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# TPU stem optimizations (SpaceToDepthStem, max_pool_eq_grad) — numerics vs
+# the naive formulations they replace.
+# ---------------------------------------------------------------------------
+
+def test_s2d_stem_matches_naive_conv():
+    """SpaceToDepthStem is an exact re-indexing of conv 7x7/s2 SAME."""
+    import flax.linen as nn
+    from horovod_tpu.models.resnet import SpaceToDepthStem
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    stem = SpaceToDepthStem(features=16, dtype=jnp.float32)
+    params = stem.init(jax.random.PRNGKey(1), x)
+    ref = nn.Conv(16, (7, 7), (2, 2), padding="SAME", use_bias=False,
+                  dtype=jnp.float32)
+    y_s2d = stem.apply(params, x)
+    y_ref = ref.apply({"params": {"kernel": params["params"]["kernel"]}}, x)
+    assert y_s2d.shape == y_ref.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_max_pool_eq_grad_forward_and_backward():
+    from horovod_tpu.models.resnet import max_pool_eq_grad
+    import flax.linen as nn
+    # Unique-maxima input: no ties, so the equality backward must equal
+    # select_and_scatter's exactly.
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.permutation(2 * 12 * 12 * 3).reshape(2, 12, 12, 3),
+                    jnp.float32)
+    g_out = jnp.asarray(rng.randn(2, 6, 6, 3), jnp.float32)
+
+    def naive(v):
+        return jnp.sum(nn.max_pool(v, (3, 3), (2, 2), padding="SAME")
+                       * g_out)
+
+    def fast(v):
+        return jnp.sum(max_pool_eq_grad(v) * g_out)
+
+    np.testing.assert_allclose(np.asarray(max_pool_eq_grad(x)),
+                               np.asarray(nn.max_pool(x, (3, 3), (2, 2),
+                                                      padding="SAME")))
+    np.testing.assert_allclose(np.asarray(jax.grad(fast)(x)),
+                               np.asarray(jax.grad(naive)(x)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_max_pool_eq_grad_ties_preserve_sum():
+    """With ties the 1/n-per-tie convention must conserve the gradient
+    sum (select_and_scatter routes it all to the first max instead)."""
+    from horovod_tpu.models.resnet import max_pool_eq_grad
+    x = jnp.ones((1, 8, 8, 1), jnp.float32)  # every window fully tied
+    g_out = jnp.asarray(np.random.RandomState(3).rand(1, 4, 4, 1),
+                        jnp.float32)
+
+    def fast(v):
+        return jnp.sum(max_pool_eq_grad(v) * g_out)
+
+    grad = jax.grad(fast)(x)
+    np.testing.assert_allclose(float(jnp.sum(grad)), float(jnp.sum(g_out)),
+                               rtol=1e-6)
+
+
+def test_max_pool_eq_grad_rejects_odd_extent():
+    from horovod_tpu.models.resnet import max_pool_eq_grad
+    with pytest.raises(ValueError, match="even"):
+        jax.grad(lambda v: jnp.sum(max_pool_eq_grad(v)))(
+            jnp.ones((1, 7, 8, 1), jnp.float32))
+
+
+def test_resnet_fast_stem_matches_baseline_step():
+    """fast_stem=True shares the param tree and reproduces the baseline
+    forward logits (fp32, no ties in practice on random data)."""
+    base = create_resnet50(num_classes=10, dtype=jnp.float32)
+    fast = create_resnet50(num_classes=10, dtype=jnp.float32,
+                           fast_stem=True)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 64, 64, 3),
+                    jnp.float32)
+    variables = base.init(jax.random.PRNGKey(0), x, train=False)
+    jax.tree_util.tree_map(lambda a, b: None, variables,
+                           fast.init(jax.random.PRNGKey(0), x,
+                                     train=False))  # identical tree
+    y_base = base.apply(variables, x, train=False)
+    y_fast = fast.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_base),
+                               rtol=2e-4, atol=2e-4)
