@@ -106,9 +106,9 @@ def main():
         return
     hvd.init()
     nslots = hvd.num_slots()
-    model = create_resnet50(
-        num_classes=1000, dtype=jnp.bfloat16, sync_bn=True,
-        fast_stem=os.environ.get("BENCH_FAST_STEM", "1") == "1")
+    fast_stem = os.environ.get("BENCH_FAST_STEM", "1") == "1"
+    model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16,
+                            sync_bn=True, fast_stem=fast_stem)
     rng = jax.random.PRNGKey(0)
     batch = BATCH_PER_CHIP * nslots
 
@@ -173,6 +173,8 @@ def main():
         "value": round(img_s, 2),
         "unit": "images/sec",
         "vs_baseline": round(per_dev / BASELINE_IMG_S_PER_DEV, 3),
+        "config": f"bs{BATCH_PER_CHIP}/chip bf16 sync-bn "
+                  f"{'s2d-stem' if fast_stem else 'naive-stem'}",
     }))
 
 

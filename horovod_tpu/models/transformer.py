@@ -45,6 +45,16 @@ class TransformerConfig:
     seq_parallel: Optional[str] = None   # None|'ring'|'ring_striped'|'ulysses'
     attention_impl: Optional[str] = None  # None (dense) | 'flash' (Pallas)
     remat: bool = False
+    # Mixture-of-experts FFN (parallel/moe.py).  moe_experts > 0 replaces
+    # the dense FFN with a top-k-routed MoE in every ``moe_every``-th block
+    # (GShard alternation).  expert_axis names the mesh axis experts are
+    # sharded over (params carry the GLOBAL [E, ...] expert dim; shard them
+    # with in_specs on that axis) — None keeps experts replicated.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 2
+    expert_axis: Optional[str] = None
 
 
 # Benchmark-standard configurations.
@@ -108,6 +118,7 @@ class SelfAttention(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -116,6 +127,8 @@ class Block(nn.Module):
         h = ln(name="ln1")(x)
         x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype))
         h = ln(name="ln2")(x)
+        if self.use_moe:
+            return x + self._moe_ffn(h.astype(cfg.dtype))
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="fc1",
                      kernel_init=nn.initializers.normal(0.02))(
                          h.astype(cfg.dtype))
@@ -123,6 +136,37 @@ class Block(nn.Module):
         h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="fc2",
                      kernel_init=nn.initializers.normal(0.02))(h)
         return x + h
+
+    def _moe_ffn(self, h):
+        """Top-k expert-parallel FFN (parallel/moe.py).  Params hold the
+        expert dim at its LOCAL extent: the full E at init / replicated
+        apply, E / n under shard_map with the expert dim sharded over
+        cfg.expert_axis.  The aux load-balancing loss is sown into the
+        "losses" collection — apply with ``mutable=["losses"]`` and add
+        ``sum(jax.tree.leaves(mutated["losses"]))`` to the objective."""
+        from jax import lax
+        from ..parallel.moe import expert_parallel_ffn
+        cfg = self.cfg
+        n = lax.axis_size(cfg.expert_axis) if cfg.expert_axis else 1
+        if cfg.moe_experts % max(n, 1):
+            raise ValueError(f"moe_experts ({cfg.moe_experts}) must divide "
+                             f"by the {cfg.expert_axis!r} axis size ({n})")
+        e_local = cfg.moe_experts // n
+        init = nn.initializers.normal(0.02)
+        gate = self.param("moe_gate", init,
+                          (cfg.d_model, cfg.moe_experts), jnp.float32)
+        w_in = self.param("moe_w_in", init,
+                          (e_local, cfg.d_model, cfg.d_ff), jnp.float32)
+        w_out = self.param("moe_w_out", init,
+                           (e_local, cfg.d_ff, cfg.d_model), jnp.float32)
+        b, s, d = h.shape
+        res = expert_parallel_ffn(
+            h.reshape(b * s, d), gate,
+            w_in.astype(cfg.dtype), w_out.astype(cfg.dtype),
+            axis_name=cfg.expert_axis, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor)
+        self.sow("losses", "moe_aux", res.aux_loss)
+        return res.out.reshape(b, s, d)
 
 
 class Transformer(nn.Module):
@@ -161,7 +205,9 @@ class Transformer(nn.Module):
         if cfg.remat:
             block = nn.remat(Block)  # jax.checkpoint: HBM for FLOPs
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            use_moe = (cfg.moe_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied LM head (GPT-2 convention); f32 logits for a stable loss.
         logits = emb.attend(x.astype(cfg.dtype)).astype(jnp.float32)

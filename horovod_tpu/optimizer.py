@@ -109,21 +109,33 @@ def _reduce_grad_leaf(l, op, compression, prescale, postscale, process_set):
                           process_set=process_set)
 
 
-def _reduce_multi_axis_leaf(l, op, prescale, postscale, reduce_axes):
+def _reduce_multi_axis_leaf(l, op, prescale, postscale, reduce_axes,
+                            param=None):
     """Reduce one gradient leaf over a SUBSET of a multi-axis mesh's axes
-    (the dp×sp / dp×tp case the reference never reaches — SURVEY.md §2.3).
+    (the dp×sp / dp×tp / dp×ep cases the reference never reaches —
+    SURVEY.md §2.3).
 
     Semantics: psum over whichever of ``reduce_axes`` the leaf is still
     varying on (vma); leaves the shard_map transpose already summed (grads
-    of replicated params arrive invariant) are not re-summed.  AVERAGE
-    divides by the TOTAL data-parallel degree — the product of all
-    reduce_axes sizes — uniformly for both cases, so replicated-parameter
-    gradients come out as the global mean regardless of which axes XLA
-    pre-reduced."""
+    of replicated params arrive invariant) are not re-summed.  Axes the
+    PARAMETER itself varies on are excluded: a parameter sharded over an
+    axis (expert weights over 'ep') has per-shard-distinct gradients
+    there — summing would mix different parameters elementwise.
+
+    AVERAGE divides by the product of all reduce_axes sizes uniformly.
+    That is the global token mean ONLY when the batch/token dimension is
+    sharded over EVERY listed axis (the dp and dp×ep layouts); list
+    exactly the axes the batch is sharded over.  A tensor-parallel-style
+    axis that shards weights but NOT the batch must not appear in
+    reduce_axes — its gradients are already complete per shard and the
+    uniform divisor would shrink them by that axis's size."""
     vma = getattr(jax.typeof(l), "vma", frozenset())
+    param_vma = getattr(jax.typeof(param), "vma", frozenset()) \
+        if param is not None else frozenset()
     from .ops import collective_ops as C
     l = C._apply_scale(l, prescale)
-    varying = tuple(a for a in reduce_axes if a in vma)
+    varying = tuple(a for a in reduce_axes
+                    if a in vma and a not in param_vma)
     if varying:
         l = jax.lax.psum(l, varying)
     if op == ReduceOp.AVERAGE:
@@ -135,7 +147,7 @@ def _reduce_multi_axis_leaf(l, op, prescale, postscale, reduce_axes):
 
 
 def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
-                    groups=None, reduce_axes=None):
+                    groups=None, reduce_axes=None, params=None):
     """Tree-map allreduce; ``groups`` (list of param-name buckets) reproduces
     the reference's `groups` option (torch/optimizer.py grouped allreduce) —
     under jit the grouping is advisory since XLA's combiner re-buckets, so we
@@ -168,9 +180,20 @@ def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
         if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             raise ValueError(
                 f"reduce_axes supports Sum/Average gradients, got {op!r}")
+        if params is None:
+            # Without params we cannot tell an unsummed gradient from a
+            # sharded parameter's own gradient on a listed axis — the
+            # wrong guess silently elementwise-sums DIFFERENT parameters
+            # (e.g. experts).  Fail loudly instead.
+            raise ValueError(
+                "DistributedOptimizer(reduce_axes=...) needs the params "
+                "argument: call opt.update(grads, state, params) so "
+                "sharded-parameter leaves can be excluded from their own "
+                "shard axis")
         return jax.tree_util.tree_map(
-            lambda l: _reduce_multi_axis_leaf(l, op, prescale, postscale,
-                                              axes), grads)
+            lambda l, p: _reduce_multi_axis_leaf(
+                l, op, prescale, postscale, axes, param=p),
+            grads, params)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if groups:
         axis = _axis_name()
@@ -289,10 +312,9 @@ def distributed_gradient_transformation(
         return optax.EmptyState()
 
     def update_fn(updates, state, params=None):
-        del params
         reduced = _allreduce_tree(updates, op, compression, prescale,
                                   postscale, process_set, groups,
-                                  reduce_axes=reduce_axes)
+                                  reduce_axes=reduce_axes, params=params)
         return reduced, state
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -9,6 +9,8 @@ Submodules:
 * (here)      — mesh construction + ``shard_step`` SPMD wrapper
 * ring        — ring attention over ``ppermute`` (long-context SP/CP)
 * ulysses     — all-to-all sequence↔head parallelism (DeepSpeed-Ulysses style)
+* moe         — expert parallelism: GShard/Switch MoE over ``all_to_all``
+* flash       — Pallas flash-attention kernel (local attention backend)
 """
 
 from __future__ import annotations

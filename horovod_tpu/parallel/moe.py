@@ -1,0 +1,152 @@
+"""Expert parallelism: GShard/Switch-style Mixture-of-Experts over all_to_all.
+
+The reference ships only the routing primitive — Alltoallv with per-rank
+splits (collective_operations.h:199-268), which SURVEY.md §2.3 identifies as
+"the EP routing primitive; no MoE layer ships".  This module completes the
+pattern TPU-native: gating, capacity-bucketed dispatch, and the expert
+exchange expressed as dense einsums + one ``lax.all_to_all`` each way inside
+the compiled program — static shapes throughout (XLA requirement), token
+overflow handled by capacity dropping, never by dynamic shapes.
+
+Layout (inside ``shard_map`` over the expert axis, default "hvd"):
+
+* activations  [T_local, d]           — sharded over the axis (data/tokens)
+* expert weights [E_local, d, d_ff]   — sharded over the axis (experts)
+* dispatch     [T, E, C] one-hot      — built locally per shard
+* exchange     [E, C, d] ->(all_to_all)-> [E_local, n*C, d]
+
+so each device computes only its local experts on tokens gathered from every
+shard, and a mirror all_to_all routes results back.  Both exchanges ride the
+ICI torus; the einsums are MXU-shaped batched matmuls.
+
+Auxiliary load-balancing loss follows Switch Transformer (§2.2 of the paper):
+``E * sum_e f_e * P_e`` where f_e is the fraction of tokens routed to expert
+e and P_e the mean router probability.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEOutput(NamedTuple):
+    out: jax.Array          # [T_local, d] combined expert outputs
+    aux_loss: jax.Array     # scalar load-balancing loss (Switch style)
+    dropped_frac: jax.Array  # scalar: fraction of (token, choice) slots
+    # dropped by capacity — monitor; raise capacity_factor if high
+
+
+def _top_k_gating(logits: jax.Array, top_k: int):
+    """Top-k router: returns (indices [T, k], weights [T, k], probs [T, E]).
+
+    Weights are the softmax probabilities of the chosen experts,
+    renormalized over the k choices (GShard convention)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights, indices = lax.top_k(probs, top_k)
+    weights = weights / jnp.maximum(
+        weights.sum(axis=-1, keepdims=True), 1e-9)
+    return indices, weights, probs
+
+
+def _dispatch_combine(indices, weights, probs, num_experts: int,
+                      capacity: int):
+    """Build the [T, E, C] dispatch (0/1) and combine (weighted) tensors.
+
+    Position-in-expert via cumsum over tokens per (choice, expert) — the
+    static-shape GShard bucketing: a token whose position exceeds the
+    capacity is dropped (its one-hot row zeroes out)."""
+    T, k = indices.shape
+    # [k, T, E] one-hot of choices, processed choice-major so primary
+    # choices claim capacity before secondary ones.
+    onehot = jax.nn.one_hot(indices.T, num_experts, dtype=jnp.float32)
+    # Position of each token within its expert bucket, counting all
+    # earlier (choice, token) claims.
+    flat = onehot.reshape(k * T, num_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat          # claims before this one
+    in_cap = (pos < capacity).astype(jnp.float32) * flat
+    kept = in_cap.reshape(k, T, num_experts)
+    pos = pos.reshape(k, T, num_experts)
+    # [k, T, E, C] -> summed over k -> [T, E, C]
+    cap_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) \
+        * kept[..., None]
+    dispatch = cap_onehot.sum(axis=0)
+    combine = jnp.einsum("tk,ktec->tec", weights.astype(jnp.float32),
+                         cap_onehot)
+    dropped = 1.0 - kept.sum() / (T * k)
+    return dispatch, combine, dropped
+
+
+def switch_aux_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """Switch Transformer load-balancing loss: E * sum_e f_e * P_e."""
+    num_experts = probs.shape[-1]
+    f = dispatch.sum(axis=2).mean(axis=0)       # fraction routed per expert
+    p = probs.mean(axis=0)                      # mean router prob per expert
+    return num_experts * jnp.sum(f * p)
+
+
+def expert_parallel_ffn(x: jax.Array,
+                        gate_kernel: jax.Array,
+                        w_in: jax.Array,
+                        w_out: jax.Array,
+                        *,
+                        axis_name: Optional[str] = "hvd",
+                        top_k: int = 2,
+                        capacity_factor: float = 1.25,
+                        activation: Callable = jax.nn.gelu) -> MoEOutput:
+    """Mixture-of-experts FFN with experts sharded over ``axis_name``.
+
+    Args (shapes per shard, inside shard_map):
+      x:           [T, d]   local tokens
+      gate_kernel: [d, E]   router (replicated; E = global expert count)
+      w_in:        [E_local, d, d_ff]  this shard's expert up-projections
+      w_out:       [E_local, d_ff, d]  this shard's expert down-projections
+
+    ``axis_name=None`` runs the same math single-device (E_local = E) —
+    the unsharded reference used by the tests.
+    """
+    n = lax.axis_size(axis_name) if axis_name else 1
+    T, d = x.shape
+    e_local = w_in.shape[0]
+    num_experts = e_local * n
+    if gate_kernel.shape[-1] != num_experts:
+        raise ValueError(
+            f"gate maps to {gate_kernel.shape[-1]} experts but weights "
+            f"provide {e_local} local x {n} shards = {num_experts}")
+    capacity = max(1, int(capacity_factor * top_k * T / num_experts))
+
+    logits = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
+    indices, weights, probs = _top_k_gating(logits, top_k)
+    dispatch, combine, dropped = _dispatch_combine(
+        indices, weights, probs, num_experts, capacity)
+    aux = switch_aux_loss(probs, dispatch)
+
+    # [T, E, C] x [T, d] -> [E, C, d]
+    buckets = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    if axis_name:
+        # [E, C, d] = [n * E_local, C, d] --all_to_all--> every shard
+        # receives the buckets for ITS experts from all n shards:
+        # [n, E_local, C, d] -> [E_local, n * C, d].
+        buckets = buckets.reshape(n, e_local, capacity, d)
+        buckets = lax.all_to_all(buckets, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        buckets = buckets.transpose(1, 0, 2, 3).reshape(
+            e_local, n * capacity, d)
+    else:
+        buckets = buckets.reshape(e_local, capacity, d)
+
+    # Batched expert FFN: [E_local, n*C, d] @ [E_local, d, f] -> ... -> d
+    h = activation(jnp.einsum("ecd,edf->ecf", buckets, w_in))
+    h = jnp.einsum("ecf,efd->ecd", h, w_out)
+
+    if axis_name:
+        h = h.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+        h = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)
+        h = h.reshape(num_experts, capacity, d)
+    out = jnp.einsum("tec,ecd->td", combine.astype(h.dtype), h)
+    return MoEOutput(out.astype(x.dtype), aux,
+                     jnp.asarray(dropped, jnp.float32))

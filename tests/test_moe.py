@@ -1,0 +1,179 @@
+"""Expert-parallel MoE tests (parallel/moe.py).
+
+Pattern per SURVEY.md §4: compute the expected value with a local NumPy/JAX
+model and compare per shard; sharded-vs-unsharded equivalence on the 8-device
+CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel.moe import expert_parallel_ffn
+
+N = 8
+
+
+def _mk(seed, T=16, d=8, f=16, E=8):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(T, d), jnp.float32)
+    gate = jnp.asarray(rng.randn(d, E) * 2.0, jnp.float32)
+    w_in = jnp.asarray(rng.randn(E, d, f) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.randn(E, f, d) * 0.1, jnp.float32)
+    return x, gate, w_in, w_out
+
+
+def test_moe_top1_matches_per_token_expert():
+    """With top_k=1 and ample capacity, every token's output must be its
+    argmax expert's FFN applied to it (weight 1 after renormalization)."""
+    x, gate, w_in, w_out = _mk(0)
+    res = expert_parallel_ffn(x, gate, w_in, w_out, axis_name=None,
+                              top_k=1, capacity_factor=8.0)
+    choice = np.argmax(np.asarray(x @ gate), axis=-1)
+    for t in range(x.shape[0]):
+        e = choice[t]
+        want = np.asarray(jax.nn.gelu(x[t] @ w_in[e]) @ w_out[e])
+        np.testing.assert_allclose(np.asarray(res.out[t]), want,
+                                   rtol=1e-4, atol=1e-5)
+    assert float(res.dropped_frac) == 0.0
+
+
+def test_moe_top2_weights_sum():
+    """top_k=2: output is the prob-renormalized blend of the two chosen
+    experts' outputs."""
+    x, gate, w_in, w_out = _mk(1, T=8, E=4)
+    res = expert_parallel_ffn(x, gate, w_in, w_out, axis_name=None,
+                              top_k=2, capacity_factor=8.0)
+    probs = np.asarray(jax.nn.softmax(x @ gate, axis=-1))
+    for t in range(x.shape[0]):
+        top2 = np.argsort(-probs[t])[:2]
+        w = probs[t][top2] / probs[t][top2].sum()
+        want = sum(w[i] * np.asarray(jax.nn.gelu(x[t] @ w_in[e]) @ w_out[e])
+                   for i, e in enumerate(top2))
+        np.testing.assert_allclose(np.asarray(res.out[t]), want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_tokens():
+    """Capacity 1 per expert with many tokens on one expert: overflow slots
+    drop (zero output rows for top_k=1), dropped_frac reports it."""
+    T, d = 12, 4
+    x = jnp.ones((T, d), jnp.float32)           # identical tokens
+    gate = jnp.zeros((d, 2), jnp.float32).at[0, 0].set(5.0)  # all -> e0
+    rng = np.random.RandomState(2)
+    w_in = jnp.asarray(rng.randn(2, d, 8) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.randn(2, 8, d) * 0.1, jnp.float32)
+    res = expert_parallel_ffn(x, gate, w_in, w_out, axis_name=None,
+                              top_k=1, capacity_factor=1.0 / 6.0)
+    # capacity = max(1, 1/6 * 1 * 12 / 2) = 1 -> one token kept
+    kept_rows = np.abs(np.asarray(res.out)).sum(axis=1) > 0
+    assert kept_rows.sum() == 1
+    np.testing.assert_allclose(float(res.dropped_frac), 11 / 12, rtol=1e-6)
+
+
+def test_moe_sharded_matches_unsharded(hvd8):
+    """8-way expert parallelism (1 expert/shard, tokens sharded) must
+    reproduce the unsharded math when nothing is capacity-dropped."""
+    T, d, f, E = 64, 8, 16, 8
+    x, gate, w_in, w_out = _mk(3, T=T, d=d, f=f, E=E)
+    ref = expert_parallel_ffn(x, gate, w_in, w_out, axis_name=None,
+                              top_k=2, capacity_factor=16.0)
+    mesh = hvd8.mesh()
+
+    def local(xs, gates, wi, wo):
+        res = expert_parallel_ffn(xs, gates, wi, wo, axis_name="hvd",
+                                  top_k=2, capacity_factor=16.0)
+        return res.out, jax.lax.pmax(res.dropped_frac, "hvd")
+
+    out, dropped = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("hvd"), P(), P("hvd"), P("hvd")),
+        out_specs=(P("hvd"), P())))(x, gate, w_in, w_out)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref.out),
+                               rtol=1e-4, atol=1e-5)
+    assert float(jnp.max(dropped)) == 0.0
+
+
+def test_moe_aux_loss_balanced_vs_skewed():
+    """The Switch aux loss must be ~1 for a uniform router and larger for
+    a collapsed one."""
+    x, _, w_in, w_out = _mk(4, T=64, E=8)
+    uniform_gate = jnp.zeros((x.shape[1], 8), jnp.float32)
+    skewed_gate = uniform_gate.at[:, 0].set(9.0)
+    res_u = expert_parallel_ffn(x, uniform_gate, w_in, w_out,
+                                axis_name=None, top_k=1,
+                                capacity_factor=8.0)
+    res_s = expert_parallel_ffn(x, skewed_gate, w_in, w_out,
+                                axis_name=None, top_k=1,
+                                capacity_factor=8.0)
+    assert float(res_s.aux_loss) > 2.0 * float(res_u.aux_loss)
+    assert 0.5 < float(res_u.aux_loss) < 2.0
+
+
+def test_moe_transformer_trains(hvd8):
+    """A tiny MoE transformer (2 experts, every 2nd block) trains: loss +
+    sown aux loss decrease under the DistributedOptimizer step."""
+    import dataclasses
+    import optax
+    from horovod_tpu.models import Transformer, TransformerConfig, lm_loss
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            d_model=32, d_ff=64, max_len=16, causal=True,
+                            dtype=jnp.float32, moe_experts=2,
+                            moe_capacity_factor=4.0)
+    model = Transformer(cfg)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    assert "moe_gate" in params["params"]["block_1"]
+    assert "fc1" in params["params"]["block_0"]  # alternation
+    opt = hvd.DistributedOptimizer(optax.adam(1e-2))
+    opt_state = opt.init(params)
+
+    def local_step(params, opt_state, toks):
+        def loss_fn(p):
+            logits, mut = model.apply(p, toks, mutable=["losses"])
+            aux = sum(jax.tree.leaves(mut["losses"]))
+            return lm_loss(logits, toks) + 0.01 * aux
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            hvd.allreduce(loss, op=hvd.Average)
+
+    step = hvd.parallel.shard_step(
+        local_step, in_specs=(P(), P(), P("hvd")),
+        out_specs=(P(), P(), P()))
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_transformer_expert_sharded_matches_replicated(hvd8):
+    """cfg.expert_axis='hvd': the same params, with expert dims sharded by
+    in_specs, must produce the replicated model's logits (ample capacity)."""
+    import dataclasses
+    from horovod_tpu.models import Transformer, TransformerConfig
+    base = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                             d_model=32, d_ff=64, max_len=16, causal=True,
+                             dtype=jnp.float32, moe_experts=8,
+                             moe_capacity_factor=16.0)
+    cfg_ep = dataclasses.replace(base, expert_axis="hvd")
+    model_r = Transformer(base)
+    model_s = Transformer(cfg_ep)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 64, (8, 16)))
+    params = model_r.init(jax.random.PRNGKey(0), tokens)
+    ref = model_r.apply(params, tokens)
+
+    def ep_spec(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return P("hvd") if name in ("moe_w_in", "moe_w_out") else P()
+
+    specs = jax.tree_util.tree_map_with_path(ep_spec, params)
+    mesh = hvd8.mesh()
+    out = jax.jit(jax.shard_map(
+        lambda p, t: model_s.apply(p, t), mesh=mesh,
+        in_specs=(specs, P("hvd")), out_specs=P("hvd")))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

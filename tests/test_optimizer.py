@@ -324,3 +324,36 @@ def test_reduce_axes_outside_mesh_raises():
     with pytest.raises(ValueError, match="not bound"):
         opt.update({"w": jnp.ones((2,))}, opt.init({"w": jnp.ones((2,))}),
                    {"w": jnp.ones((2,))})
+
+
+def test_reduce_axes_param_sharded_leaf_not_summed_over_its_axis():
+    """A parameter SHARDED over one of the reduce axes (expert/tensor-
+    parallel leaf) must have its gradient psum'd only over the remaining
+    axes — summing over the shard axis would mix different parameters —
+    while AVERAGE still divides by the full dp*ep degree."""
+    import jax
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    import horovod_tpu as hvd
+
+    dp, ep = 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:dp * ep]).reshape(dp, ep),
+                ("dp", "ep"))
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), reduce_axes=("dp", "ep"))
+    # one "expert row" per (dp, ep) cell; parameter sharded over ep
+    g = jnp.asarray(np.random.RandomState(7).randn(dp, ep, 3)
+                    .astype(np.float32))
+    w = jnp.zeros((ep, 3), jnp.float32)
+
+    def body(wl, gl):
+        # wl: [1, 3] this ep-shard's expert; gl: [1, 1, 3] local grad
+        state = opt.init({"e": wl})
+        updates, _ = opt.update({"e": gl[0]}, state, {"e": wl})
+        return updates["e"]
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("ep"), P("dp", "ep")),
+        out_specs=P("ep")))(w, g)   # [ep, 3] reassembled over shards
+    # expected: -(sum over dp of g) / (dp * ep), per ep shard
+    want = -np.asarray(g).sum(axis=0) / (dp * ep)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
