@@ -10,6 +10,8 @@ Submodules:
 * ring        — ring attention over ``ppermute`` (long-context SP/CP)
 * ulysses     — all-to-all sequence↔head parallelism (DeepSpeed-Ulysses style)
 * moe         — expert parallelism: GShard/Switch MoE over ``all_to_all``
+* pipeline    — GPipe-style microbatch pipelining over ``ppermute``
+* tensor      — Megatron column/row-sharded matmul pairs (TP)
 * flash       — Pallas flash-attention kernel (local attention backend)
 """
 

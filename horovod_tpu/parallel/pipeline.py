@@ -1,0 +1,93 @@
+"""Pipeline parallelism: GPipe-style SPMD microbatch pipelining over
+``ppermute``.
+
+Not in the reference's scope (SURVEY.md §2.3 marks PP absent); built here
+because the TPU mesh design gets it cheaply and it completes the dp/sp/ep/pp
+strategy set.  TPU-first shape: the schedule is a ``lax.scan`` over
+M + S - 1 ticks compiled into ONE program — every stage computes every tick
+(idle ticks are masked, not branched; XLA forbids data-dependent control
+flow), and stage boundaries are a single ``lax.ppermute`` hop to the next
+torus neighbor.  The backward pass needs no hand-written 1F1B: scan and
+ppermute transpose under ``jax.grad`` into the reverse schedule
+automatically.
+
+Usage (inside shard_map over the 'pp' axis; see tests/test_pipeline.py):
+
+    def stage_fn(stage_params, x):        # one pipeline stage
+        return jnp.tanh(x @ stage_params)
+
+    ys = gpipe_spmd(stage_fn, my_stage_params, xs, axis_name="pp")
+
+``xs`` is [M, mb, ...] microbatches replicated across the pp axis;
+``my_stage_params`` is this shard's slice of the stacked per-stage params
+(shard the leading stage dim with ``in_specs=P("pp")``).  The result is
+the last stage's outputs, broadcast to every pp shard (masked psum) so the
+caller can compute a replicated loss.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe_spmd(stage_fn: Callable, stage_params, xs: jax.Array,
+               *, axis_name: str = "pp") -> jax.Array:
+    """Run ``stage_fn`` as a pipeline of axis-size stages over M
+    microbatches.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``
+        unchanged across stages (uniform-stage pipeline; rank-polymorphic
+        stages need a wrapper that pads to a common activation shape).
+      stage_params: this shard's parameters (pytree).
+      xs: [M, mb, ...] microbatches, identical on every pp shard.
+    Returns:
+      [M, mb, ...] final-stage outputs, replicated across the pp axis.
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = xs.shape[0]
+    ticks = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        buf, ys = carry
+        # Stage 0 ingests microbatch t (clipped; masked out-of-range ticks
+        # just compute garbage that never lands in ys); later stages take
+        # the neighbor's activation from the previous tick.
+        feed = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
+                                        keepdims=False)
+        x_in = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(stage_params, x_in)
+        # The LAST stage finished microbatch m = t - (S - 1) this tick.
+        m = t - (S - 1)
+        mc = jnp.clip(m, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(ys, mc, 0, keepdims=False)
+        upd = jnp.where((m >= 0) & (m < M) & (idx == S - 1), y, cur)
+        ys = lax.dynamic_update_index_in_dim(ys, upd, mc, 0)
+        # One hop along the ring: this tick's output becomes the next
+        # stage's next-tick input (stage S-1 -> 0 wraps; stage 0 ignores).
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, ys), None
+
+    # Cast to axis-varying: the loop writes varying values into these
+    # (ppermute output, idx-masked updates); the scan carry type must
+    # match from iteration 0.
+    buf0 = lax.pcast(jnp.zeros_like(xs[0]), axis_name, to="varying")
+    ys0 = lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
+    (_, ys), _ = lax.scan(tick, (buf0, ys0), jnp.arange(ticks))
+    # Broadcast the last stage's outputs to all pp shards (masked psum) so
+    # every shard holds the replicated result for the loss.
+    return lax.psum(jnp.where(idx == S - 1, ys, jnp.zeros_like(ys)),
+                    axis_name)
+
+
+def stack_stage_params(params_per_stage) -> jax.Array:
+    """Stack a list of per-stage pytrees along a new leading stage dim —
+    the layout ``gpipe_spmd`` expects sharded with ``P('pp')``."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *params_per_stage)

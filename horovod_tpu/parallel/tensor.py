@@ -1,0 +1,42 @@
+"""Tensor (model) parallelism helpers: Megatron-style sharded matmul pairs.
+
+Not in the reference's scope (SURVEY.md §2.3 marks TP absent — process sets
+are its only enabler there).  On a TPU mesh the pattern is two einsums and
+one psum riding ICI: a column-parallel projection (no communication — each
+shard computes a distinct slice of the hidden dim), a row-parallel
+projection of the local slice, and a single ``psum`` to sum the partial
+outputs.  XLA overlaps the psum with the surrounding compute where the
+schedule allows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_row_parallel_mlp(x: jax.Array, w_col: jax.Array,
+                            w_row: jax.Array, *, axis_name: str = "tp",
+                            activation: Callable = jax.nn.gelu) -> jax.Array:
+    """Two-layer MLP with the hidden dimension sharded over ``axis_name``.
+
+    Args (per shard, inside shard_map):
+      x:     [..., d]      replicated activations
+      w_col: [d, f/n]      column shard of the up-projection
+      w_row: [f/n, d]      row shard of the down-projection
+    Returns [..., d], identical on every shard (one psum)."""
+    h = activation(x @ w_col)
+    return lax.psum(h @ w_row, axis_name)
+
+
+def shard_columns(w: jax.Array, n: int):
+    """Split [d, f] into n column shards [d, f/n] (test/setup helper)."""
+    return jnp.split(w, n, axis=1)
+
+
+def shard_rows(w: jax.Array, n: int):
+    """Split [f, d] into n row shards [f/n, d]."""
+    return jnp.split(w, n, axis=0)
