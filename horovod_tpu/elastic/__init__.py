@@ -156,7 +156,7 @@ class _RendezvousLiveness:
         Raises RendezvousUnreachableError once signals have been sustained
         for the window."""
         dead = isinstance(e, (ConnectionRefusedError, ConnectionResetError,
-                              TimeoutError)) or \
+                              BrokenPipeError, TimeoutError)) or \
             (isinstance(e, OSError) and e.errno in self._DEAD_ERRNOS)
         if not dead:
             return False

@@ -222,6 +222,21 @@ ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
 ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
 
 
+def migrate_pre_r3_checkpoint(params):
+    """Migrate a checkpoint saved before the stem went bias-free.
+
+    Earlier rounds' ``conv_init`` carried a bias that BN immediately
+    subtracted out; dropping it changed the param tree, so old checkpoints
+    no longer restore directly.  This deletes the redundant ``bias`` leaf
+    (a no-op if already absent) and returns a tree matching the current
+    model.  Safe because the bias never affected the function computed."""
+    import flax
+    flat = flax.traverse_util.flatten_dict(flax.core.unfreeze(params))
+    flat = {k: v for k, v in flat.items()
+            if not (k[-1] == "bias" and "conv_init" in k)}
+    return flax.traverse_util.unflatten_dict(flat)
+
+
 def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
                     sync_bn: bool = False, fast_stem: bool = False):
     """``fast_stem=True`` enables the two TPU stem optimizations

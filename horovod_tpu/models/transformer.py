@@ -147,7 +147,18 @@ class Block(nn.Module):
         from jax import lax
         from ..parallel.moe import expert_parallel_ffn
         cfg = self.cfg
-        n = lax.axis_size(cfg.expert_axis) if cfg.expert_axis else 1
+        if cfg.expert_axis:
+            try:
+                n = lax.axis_size(cfg.expert_axis)
+            except NameError as e:
+                raise ValueError(
+                    f"expert_axis={cfg.expert_axis!r} is not bound — "
+                    "initialize with expert_axis=None (params carry the "
+                    "global [E, ...] expert dim) and shard them via "
+                    "in_specs on the expert axis under shard_map; see "
+                    "docs/moe.md") from e
+        else:
+            n = 1
         if cfg.moe_experts % max(n, 1):
             raise ValueError(f"moe_experts ({cfg.moe_experts}) must divide "
                              f"by the {cfg.expert_axis!r} axis size ({n})")

@@ -70,9 +70,10 @@ def _dispatch_combine(indices, weights, probs, num_experts: int,
     in_cap = (pos < capacity).astype(jnp.float32) * flat
     kept = in_cap.reshape(k, T, num_experts)
     pos = pos.reshape(k, T, num_experts)
-    # [k, T, E, C] -> summed over k -> [T, E, C]
-    cap_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) \
-        * kept[..., None]
+    # [k, T, E, C] -> summed over k -> [T, E, C].  pos comes from a float
+    # cumsum; one_hot wants integer positions (float is deprecated).
+    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32) * kept[..., None]
     dispatch = cap_onehot.sum(axis=0)
     combine = jnp.einsum("tk,ktec->tec", weights.astype(jnp.float32),
                          cap_onehot)

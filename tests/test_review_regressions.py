@@ -138,3 +138,44 @@ def test_spawn_failure_counts_as_rank_failure(tmp_path):
          "definitely_not_a_real_binary_xyz"],
         cwd=repo, capture_output=True, text=True, timeout=60)
     assert proc.returncode != 0
+
+
+def test_moe_unbound_expert_axis_raises_helpful_error():
+    """ADVICE r2: init with expert_axis set outside shard_map must raise a
+    ValueError naming the supported pattern, not an opaque NameError."""
+    import jax
+    from horovod_tpu.models.transformer import Transformer, TransformerConfig
+    cfg = TransformerConfig(num_layers=2, num_heads=2, d_model=32, d_ff=64,
+                            vocab_size=64, max_len=16, moe_experts=4,
+                            expert_axis="ep")
+    with pytest.raises(ValueError, match="expert_axis=None"):
+        Transformer(cfg).init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 16), jnp.int32))
+
+
+def test_resnet_checkpoint_migration_drops_stem_bias():
+    """Pre-r3 checkpoints carried a redundant conv_init bias; the migration
+    helper must drop it so the tree matches the current model."""
+    import jax
+    from horovod_tpu.models import create_resnet50
+    from horovod_tpu.models.resnet import migrate_pre_r3_checkpoint
+    model = create_resnet50(num_classes=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 64, 64, 3)), train=False)["params"]
+    old = dict(params)
+    old["conv_init"] = dict(old["conv_init"])
+    old["conv_init"]["bias"] = jnp.zeros((64,))
+    migrated = migrate_pre_r3_checkpoint(old)
+    assert "bias" not in migrated["conv_init"]
+    assert jax.tree_util.tree_structure(migrated) == \
+        jax.tree_util.tree_structure(dict(params))
+
+
+def test_rendezvous_liveness_broken_pipe_is_dead_signal():
+    """ADVICE r2: BrokenPipeError (Python's mapping of EPIPE) must count as
+    transport-dead; an HTTP-status OSError must not."""
+    from horovod_tpu.elastic import _RendezvousLiveness
+    lv = _RendezvousLiveness("h", 1)
+    assert lv.note(BrokenPipeError(32, "broken pipe"))
+    lv.ok()
+    assert not lv.note(OSError("KV PUT failed: HTTP 500"))
