@@ -52,18 +52,23 @@ def bench_bert():
     import contextlib
     from examples.bert_pretraining import main as bert_main
     bs = os.environ.get("BENCH_BERT_BATCH", "32")
+    attn = os.environ.get("BENCH_BERT_ATTN", "auto")
+    mlm_pos = os.environ.get("BENCH_BERT_MLMPOS", "20")
+    argv = ["--size", "large", "--steps", "10", "--batch-per-slot", bs,
+            "--seq-len", "128", "--attention", attn,
+            "--mlm-positions", mlm_pos]
     with contextlib.redirect_stdout(sys.stderr):  # keep stdout = 1 JSON line
-        losses, samples_s = bert_main(["--size", "large", "--steps", "10",
-                                       "--batch-per-slot", bs,
-                                       "--seq-len", "128"])
+        losses, samples_s = bert_main(argv)
     print(json.dumps({
         "metric": "bert_large_mlm_samples_per_sec",
         "value": round(samples_s, 2),
         "unit": "samples/sec",
         "vs_baseline": round(samples_s / hvd.num_slots(), 3),
         # Not comparable across configs: round-1/2 records used bs 8 with
-        # remat on; this records the actual measurement setup.
-        "config": f"bs{bs}/slot seq128 accum2 no-remat",
+        # remat on and the full-sequence LM head; this records the actual
+        # measurement setup.
+        "config": f"bs{bs}/slot seq128 accum2 no-remat attn-{attn} "
+                  f"mlmpos{mlm_pos}",
     }))
 
 

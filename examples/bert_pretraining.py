@@ -53,6 +53,20 @@ def mlm_batch(rng, batch, seq_len, vocab, mask_rate=0.15):
             jnp.asarray(mask.astype(np.float32)))
 
 
+def mlm_batch_fixed_positions(rng, batch, seq_len, vocab, num_positions):
+    """Exactly ``num_positions`` masked slots per sequence (standard BERT
+    max_predictions_per_seq).  Returns (inputs, positions [B,K], labels
+    [B,K]); the LM head runs only at the gathered positions."""
+    tokens = rng.randint(5, vocab, size=(batch, seq_len)).astype(np.int32)
+    positions = np.stack([
+        np.sort(rng.choice(seq_len, size=num_positions, replace=False))
+        for _ in range(batch)]).astype(np.int32)
+    labels = np.take_along_axis(tokens, positions, axis=1)
+    inputs = tokens.copy()
+    np.put_along_axis(inputs, positions, MASK_ID, axis=1)
+    return jnp.asarray(inputs), jnp.asarray(positions), jnp.asarray(labels)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=["tiny", "base", "large"])
@@ -66,23 +80,44 @@ def main(argv=None):
                          "off when activations would not fit HBM (long "
                          "seq / large batch) — at seq 128 it costs ~1/3 "
                          "extra forward FLOPs for nothing")
+    ap.add_argument("--attention", default="auto",
+                    choices=["auto", "dense", "flash"],
+                    help="'flash' = Pallas kernel (fwd+bwd); 'auto' picks "
+                         "flash on TPU, dense elsewhere")
+    ap.add_argument("--mlm-positions", type=int, default=0,
+                    help="if >0, generate exactly this many masked "
+                         "positions per sequence and apply the LM head "
+                         "only at them (standard BERT "
+                         "max_predictions_per_seq; the head over all "
+                         f"positions wastes ~6x its FLOPs at 15%% masking)")
     args = ap.parse_args(argv)
 
     hvd.init()
     nslots = hvd.num_slots()
+    attn = args.attention
+    if attn == "auto":
+        attn = "flash" if jax.default_backend() == "tpu" else "dense"
+    attn_impl = "flash" if attn == "flash" else None
     if args.size == "tiny":
-        cfg = TINY
+        cfg = dataclasses.replace(TINY, attention_impl=attn_impl)
     else:
         from horovod_tpu.models import BERT_BASE
         cfg = {"base": BERT_BASE, "large": BERT_LARGE}[args.size]
-        cfg = dataclasses.replace(cfg, max_len=args.seq_len,
-                                  remat=args.remat)
+        cfg = dataclasses.replace(
+            cfg, max_len=args.seq_len, remat=args.remat,
+            attention_impl=attn_impl)
     model = Transformer(cfg)
     batch = args.batch_per_slot * nslots
     seq_len = min(args.seq_len, cfg.max_len)
 
     rng = np.random.RandomState(hvd.rank())
-    inputs, targets, mask = mlm_batch(rng, batch, seq_len, cfg.vocab_size)
+    if args.mlm_positions:
+        inputs, positions, labels = mlm_batch_fixed_positions(
+            rng, batch, seq_len, cfg.vocab_size, args.mlm_positions)
+        targets, mask = positions, labels  # ride the same step signature
+    else:
+        inputs, targets, mask = mlm_batch(rng, batch, seq_len,
+                                          cfg.vocab_size)
     params = model.init(jax.random.PRNGKey(0), inputs[:1])
     params = hvd.broadcast_variables(params, root_rank=0)
     opt = hvd.DistributedOptimizer(
@@ -92,6 +127,10 @@ def main(argv=None):
 
     def local_step(params, opt_state, inp, tgt, msk):
         def loss_fn(p):
+            if args.mlm_positions:
+                # tgt = positions [B,K], msk = labels [B,K]
+                logits = model.apply(p, inp, predict_positions=tgt)
+                return lm_loss(logits, msk.astype(jnp.int32))
             logits = model.apply(p, inp)
             return lm_loss(logits, tgt, msk)
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -101,7 +140,12 @@ def main(argv=None):
 
     step = hvd.parallel.shard_step(
         local_step, in_specs=(P(), P(), P("hvd"), P("hvd"), P("hvd")),
-        out_specs=(P(), P(), P()), donate_argnums=(0, 1))
+        out_specs=(P(), P(), P()), donate_argnums=(0, 1),
+        # Pallas *interpreter* (flash off-TPU) inlines the kernel, mixing
+        # invariant loop indices with varying data; the compiled TPU path
+        # needs no escape hatch (parallel/flash.py docstring).
+        check_vma=not (attn == "flash"
+                       and jax.default_backend() != "tpu"))
 
     # Keep per-step losses ON DEVICE: a float() per step is a host
     # round-trip that serializes dispatch (catastrophic through a remote

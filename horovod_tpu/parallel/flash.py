@@ -1,22 +1,33 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as differentiable Pallas TPU kernels.
 
 The local-attention compute inside sequence parallelism (the per-step block
 math of ring attention, or the full-sequence-per-head-subset attention of
-Ulysses) is the hot loop of long-context training.  This kernel keeps the
-whole online-softmax accumulation in VMEM — one [Bq, D] query block streams
-over K/V blocks with running (max, sum, acc) state, so the [S, S] score
-matrix never touches HBM and every matmul lands on the MXU with
-``preferred_element_type=float32``.
+Ulysses) and the dense encoder attention of BERT/GPT are the hot loops this
+kernel serves.  FlashAttention-2 structure, mapped onto the Mosaic pipeline:
+
+* **Forward** — grid ``(B*H, q_blocks, k_blocks)`` with the K/V block index
+  as an ``arbitrary`` (sequential) grid dimension.  Each K/V block is a
+  grid-indexed ``BlockSpec``, so Mosaic double-buffers the HBM→VMEM DMA of
+  block *i+1* against the MXU compute of block *i* automatically — the
+  whole online-softmax state (running max / sum / accumulator) lives in
+  VMEM scratch that persists across the sequential dimension.  The [S, S]
+  score matrix never touches HBM.  Emits the per-row logsumexp as a
+  residual for the backward pass.
+* **Backward** — two kernels of the same shape (FlashAttention-2 split):
+  one accumulates dQ streaming over K/V blocks, one accumulates dK/dV
+  streaming over Q blocks; both recompute the probabilities from the saved
+  logsumexp instead of materializing them.
+* ``jax.custom_vjp`` ties them together, so the kernel drops into
+  ``jax.grad`` training steps (the BERT/GPT benches) directly.
 
 Parity note: the reference has no attention kernels at all (it is a
 communication library); this is part of the TPU build's "beat the baseline"
-surface (SURVEY.md §5.8).  Numerics are validated against the dense
-reference implementation in tests (CPU interpret mode) and the kernel is
-exercised on the real chip by bench/examples.
+surface (SURVEY.md §5.8).  Numerics (forward AND gradients) are validated
+against the dense reference implementation in tests (CPU interpret mode)
+and the kernel is exercised on the real chip by bench/examples.
 
-Layout: [B, S, H, D] public API; internally [B*H, S, D] with grid
-(batch*heads, q_blocks).  Block sizes default to 128 (MXU tile) and clamp
-to the sequence length.
+Layout: [B, S, H, D] public API; internally [B*H, S, D].  Block sizes
+default to 128 (MXU tile) and clamp to the sequence length.
 """
 
 from __future__ import annotations
@@ -35,49 +46,258 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+_LANES = 128  # VMEM lane width: (block_q, _LANES) scratch keeps m/l aligned
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                  block_q: int, block_k: int, seq_len: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
-    num_kb = pl.cdiv(seq_len, block_k)
+def _causal_mask(s, qi, kb, block_q, block_k):
+    qg = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kg = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qg >= kg, s, NEG_INF)
 
-    def body(kb, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                num_kb: int):
+    qi, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+
+    # Causal: blocks entirely above the diagonal contribute nothing — skip
+    # the MXU work (their DMA is already in flight; acceptable overfetch).
+    contributes = True if not causal else \
+        kb * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)              # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [Bq, Bk]
         if causal:
-            qg = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kg = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qg >= kg, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            s = _causal_mask(s, qi, kb, block_q, block_k)
+        m_prev = m[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l[...] = jnp.broadcast_to(
+            l[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True), l.shape)
+        m[...] = jnp.broadcast_to(m_new, m.shape)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
             p, v, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
 
-    if causal:
-        # Only blocks with kb*block_k <= qi*block_q + block_q - 1 contribute;
-        # iterating past the diagonal would add fully-masked blocks (harmless
-        # numerically, wasted MXU cycles).
-        last = jnp.minimum(num_kb, (qi * block_q + block_q + block_k - 1)
-                           // block_k)
-    else:
-        last = num_kb
-    acc0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        l_final = jnp.maximum(l[:, :1], 1e-30)
+        o_ref[0] = (acc[...] / l_final).astype(o_ref.dtype)
+        lse_ref[0] = (m[:, 0] + jnp.log(l_final[:, 0]))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale: float, causal: bool, block_q: int,
+                   block_k: int, num_kb: int):
+    qi, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    contributes = True if not causal else \
+        kb * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, kb, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, num_qb: int):
+    kb, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    contributes = True if not causal else \
+        qi * block_q + block_q - 1 >= kb * block_k
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, kb, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [Bk, D]
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [Bk, D]
+
+    @pl.when(qi == num_qb - 1)
+    def _flush():
+        # q was pre-scaled, so dk_acc already carries the scale factor.
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _out_struct(shape, dtype, like):
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _compiler_params(interpret):
+    if interpret or pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _require_pltpu():
+    if pltpu is None:  # pragma: no cover
+        raise ImportError(
+            "flash_attention needs jax.experimental.pallas.tpu (for VMEM "
+            "scratch allocation, used even by the CPU interpreter); this "
+            "JAX build does not provide it")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    num_qb, num_kb = S // block_q, S // block_k
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               num_kb=num_kb)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=[_out_struct((BH, S, D), q.dtype, q),
+                   _out_struct((BH, S), jnp.float32, q)],
+        grid=(BH, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kb: (bh, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    num_qb, num_kb = S // block_q, S // block_k
+    do = g
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise pass; let XLA fuse it
+    # in f32.  dO itself stays in its original dtype (the kernels upcast
+    # per-block in VMEM; a host-side astype would double bf16 DMA traffic).
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                   # [BH, S]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kb=num_kb),
+        out_shape=_out_struct((BH, S, D), q.dtype, q),
+        grid=(BH, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kb: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kb: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, qi, kb: (bh, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_qb=num_qb),
+        out_shape=[_out_struct((BH, S, D), k.dtype, k),
+                   _out_struct((BH, S, D), v.dtype, v)],
+        grid=(BH, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, kb, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, kb, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -87,7 +307,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = 128,
                     block_k: int = 128,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Flash attention over [B, S, H, D] (full local sequence).
+    """Differentiable flash attention over [B, S, H, D] (full local seq).
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     same call works in the CPU-mesh test environment.  In interpret mode
@@ -108,27 +328,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def reshape_in(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    qf, kf, vf = (reshape_in(x) for x in (q, k, v))
-    grid = (B * H, S // block_q)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, seq_len=S)
-    # Inside shard_map the output's varying-manual-axes must be declared;
-    # the attention output varies exactly as q does.
-    vma = getattr(jax.typeof(q), "vma", None)
-    if vma:
-        out_shape = jax.ShapeDtypeStruct((B * H, S, D), q.dtype, vma=vma)
-    else:
-        out_shape = jax.ShapeDtypeStruct((B * H, S, D), q.dtype)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=out_shape,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        interpret=interpret,
-    )(qf, kf, vf)
+    out = _flash(reshape_in(q), reshape_in(k), reshape_in(v),
+                 causal, scale, block_q, block_k, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
