@@ -314,6 +314,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     under shard_map, pass ``check_vma=False`` to the shard_map (the
     interpreter inlines the kernel, mixing invariant loop indices with
     varying data); the compiled TPU path needs no such escape hatch."""
+    _require_pltpu()
     B, S, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if interpret is None:

@@ -7,6 +7,7 @@ launchers over Spark barrier tasks) plus the Estimator layer
 """
 
 from ..spark_integration import run  # noqa: F401
+from .elastic import run_elastic  # noqa: F401
 from .store import (  # noqa: F401
     Store, FilesystemStore, LocalStore, shard_row_groups,
 )

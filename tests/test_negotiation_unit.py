@@ -2,6 +2,7 @@
 over a local KV store — fast coverage of the coordinator/worker contract
 without spawning worker processes."""
 
+import os
 import threading
 
 import pytest
@@ -94,3 +95,46 @@ def test_ps_id_mismatch_rejected(kv_env):
         ("x", "allreduce", "float32", (4,), 1, 1.0, 1.0, 1),
         ("x", "allreduce", "float32", (4,), 1, 1.0, 1.0, 2))
     assert any(isinstance(e, HorovodInternalError) for e in errs)
+
+
+def test_kv_long_poll_blocks_until_put():
+    """GET ?wait=s must hold until the key appears (no 404 race) and a
+    late key must still 404 after the wait elapses."""
+    import threading
+    import time as _time
+    from horovod_tpu.runner.http_server import KVStoreServer, KVStoreClient
+    srv = KVStoreServer()
+    port = srv.start(0)
+    try:
+        c = KVStoreClient("127.0.0.1", port)
+        # times out -> None
+        t0 = _time.perf_counter()
+        assert c.get("s", "never", wait=0.2) is None
+        assert _time.perf_counter() - t0 >= 0.18
+        # concurrent put releases the waiter with the value
+        def put_later():
+            _time.sleep(0.15)
+            srv.put("s", "k", b"v1")
+        th = threading.Thread(target=put_later)
+        th.start()
+        t0 = _time.perf_counter()
+        assert c.get("s", "k", wait=5.0) == b"v1"
+        assert _time.perf_counter() - t0 < 4.0
+        th.join()
+    finally:
+        srv.stop()
+
+
+def test_control_plane_scale_smoke():
+    """Regression guard for the round-3 control-plane fixes (Nagle stall,
+    polling saturation).  Budgets are loose — this box has ONE core shared
+    by all workers and the server — but they sit far below the broken
+    behavior (new-sig p50 was 400+ ms at np=8 pre-fix, cached p50 64 ms
+    at np=16)."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    from control_plane_bench import run_scale
+    row = run_scale(4, names=10, repeats=5)
+    assert row["new_p50_ms"] < 150, row
+    assert row["hit_p50_ms"] < 25, row

@@ -1,0 +1,121 @@
+"""horovod_tpu.spark.run_elastic — elastic training over a Spark-style task
+pool (reference: horovod/spark/runner.py:312 run_elastic).
+
+No pyspark in the image, so the task pool is threads running the REAL
+task_pool_loop (register/heartbeat/launch-subprocess protocol); only the
+``_spark_task_pool`` RDD adapter goes unexercised — the same split the
+reference uses when it tests elastic-on-Spark through fake task services.
+"""
+
+import os
+import threading
+
+import pytest
+
+from horovod_tpu.spark.elastic import (SparkTaskPoolDiscovery,
+                                       run_elastic, task_pool_loop)
+
+
+def thread_pool_factory(hostnames=None):
+    """Task pool of threads on fake hostnames (default: all on one host)."""
+
+    def factory(num_tasks, addr, port):
+        threads = []
+        for i in range(num_tasks):
+            host = (hostnames or ["node0"] * num_tasks)[i]
+            t = threading.Thread(target=task_pool_loop,
+                                 args=(addr, port, i),
+                                 kwargs={"hostname": host},
+                                 daemon=True, name=f"se-task-{i}")
+            t.start()
+            threads.append(t)
+
+        def join(timeout=30.0):
+            for t in threads:
+                t.join(timeout)
+
+        return join
+
+    return factory
+
+
+def make_report_rank():
+    """Closure, not a module-level fn: cloudpickle serializes closures by
+    VALUE, which the worker subprocess needs (the tests module is not
+    importable there)."""
+
+    def fn():
+        import os as _os
+        return (int(_os.environ["HOROVOD_RANK"]),
+                int(_os.environ["HOROVOD_SIZE"]))
+
+    return fn
+
+
+def make_crash_once(path):
+    """Rank 0's FIRST incarnation dies abruptly; every retry succeeds."""
+
+    def fn():
+        import os as _os
+        if _os.environ["HOROVOD_RANK"] == "0" and not _os.path.exists(path):
+            open(path, "w").write("crashed")
+            _os._exit(3)
+        return (int(_os.environ["HOROVOD_RANK"]),
+                int(_os.environ["HVD_TPU_WORLD_VERSION"]))
+
+    return fn
+
+
+@pytest.mark.integration
+def test_run_elastic_happy_path():
+    results = run_elastic(make_report_rank(), num_proc=2, min_num_proc=2,
+                          start_timeout=60, elastic_timeout=60,
+                          _task_pool_factory=thread_pool_factory())
+    assert results == [(0, 2), (1, 2)]
+
+
+@pytest.mark.integration
+def test_run_elastic_task_failure_then_rejoin(tmp_path):
+    """A crashed worker incarnation (os._exit inside fn) must trigger a
+    reset and relaunch on the surviving task pool; the final world's
+    results are complete (spark/runner.py:312 + elastic retry contract)."""
+    marker = str(tmp_path / "crashed_once")
+    results = run_elastic(make_crash_once(marker), num_proc=2,
+                          min_num_proc=2, start_timeout=60,
+                          elastic_timeout=60, reset_limit=3,
+                          _task_pool_factory=thread_pool_factory())
+    assert os.path.exists(marker), "first incarnation never ran"
+    ranks = [r for r, _ver in results]
+    vers = {ver for _r, ver in results}
+    assert ranks == [0, 1]
+    assert vers == {max(vers)} and max(vers) >= 1, \
+        f"expected a post-reset world, got versions {vers}"
+
+
+@pytest.mark.integration
+def test_run_elastic_multi_host_assignment():
+    """Tasks on two fake hosts: ranks spread across hosts, local ranks
+    correct."""
+    results = run_elastic(
+        make_report_rank(), num_proc=2, min_num_proc=2,
+        start_timeout=60, elastic_timeout=60,
+        _task_pool_factory=thread_pool_factory(["nodeA", "nodeB"]))
+    assert results == [(0, 2), (1, 2)]
+
+
+def test_discovery_groups_by_host_and_windows_heartbeats():
+    import json
+    import time
+    recs = {
+        "task/0": json.dumps({"host": "a", "ts": time.time()}).encode(),
+        "task/1": json.dumps({"host": "a", "ts": time.time()}).encode(),
+        "task/2": json.dumps({"host": "b", "ts": time.time()}).encode(),
+        "task/3": json.dumps({"host": "b",
+                              "ts": time.time() - 999}).encode(),
+        "unrelated": b"x",
+    }
+    d = SparkTaskPoolDiscovery(lambda: recs)
+    assert d.find_available_hosts_and_slots() == {"a": 2, "b": 1}
+    assert d.task_for_slot("a", 1) == 1
+    assert d.task_for_slot("b", 0) == 2
+    assert d.task_for_slot("b", 1) is None

