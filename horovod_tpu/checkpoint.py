@@ -35,6 +35,62 @@ def save(path: str, state: Any, force: bool = True) -> None:
         _ops.barrier()
 
 
+def save_model(path: str, params: Any, opt_state: Any = None,
+               extra: Optional[dict] = None) -> None:
+    """Persist a trained model WITH its (possibly DistributedOptimizer-
+    wrapped) optimizer state, so retraining resumes the exact trajectory —
+    the analog of saving a Keras model whose optimizer weights ride along
+    (reference keras/__init__.py:268 load_model contract).  Rank-0-writes
+    semantics of :func:`save` apply."""
+    save(path, {"params": params, "opt_state": opt_state})
+    if _core.rank() == 0:
+        # Metadata rides NEXT TO the orbax tree (not inside it): arbitrary
+        # user dicts would force restore templates to predeclare their
+        # structure; a JSON sidecar + broadcast_object on load avoids that.
+        import json
+        with open(os.path.join(os.path.abspath(path), "extra.json"),
+                  "w") as f:
+            json.dump(extra or {}, f)
+
+
+def load_model(path: str, optimizer=None, params_template: Any = None,
+               broadcast: bool = True, **wrap_kwargs):
+    """Load a model saved by :func:`save_model` and re-wrap its optimizer
+    in ``DistributedOptimizer`` so the restored state (momenta, adam
+    moments, local-aggregation counters) is picked up for retraining —
+    the reference's ``hvd.load_model`` wraps the deserialized Keras
+    optimizer the same way (keras/__init__.py:268 wrap_optimizer).
+
+    ``optimizer`` is the BASE optax optimizer (as originally passed to
+    DistributedOptimizer); ``wrap_kwargs`` forward to DistributedOptimizer
+    (backward_passes_per_step, compression, op, ...).  ``params_template``
+    supplies pytree structure for non-root ranks / orbax; rank 0 alone may
+    omit it on a single-process restore.
+
+    Returns ``(params, opt, opt_state, extra)`` where ``opt`` is the
+    wrapped optimizer ready for ``opt.update``."""
+    from .optimizer import DistributedOptimizer
+    opt = None
+    template = None
+    if optimizer is not None:
+        opt = DistributedOptimizer(optimizer, **wrap_kwargs)
+        if params_template is not None:
+            template = {"params": params_template,
+                        "opt_state": opt.init(params_template)}
+    restored = restore(path, template=template, broadcast=broadcast)
+    extra = None
+    if _core.rank() == 0 or not broadcast:
+        import json
+        extra_path = os.path.join(os.path.abspath(path), "extra.json")
+        if os.path.exists(extra_path):
+            with open(extra_path) as f:
+                extra = json.load(f)
+    topo = _core._require_init().topology
+    if broadcast and topo.size > 1 and not topo.emulated:
+        extra = _functions.broadcast_object(extra, root_rank=0)
+    return restored["params"], opt, restored.get("opt_state"), extra or {}
+
+
 def restore(path: str, template: Optional[Any] = None,
             broadcast: bool = True) -> Any:
     """Load on rank 0 and broadcast to every rank (broadcast_variables

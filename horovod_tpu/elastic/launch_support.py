@@ -29,6 +29,43 @@ def _coord_port(world_version: int) -> int:
     return coordinator_port_for(_coord_base(), world_version)
 
 
+def slot_env(slot: _hosts.SlotInfo, world_version: int, addr: str,
+             port: int, driver, coord_base: int = None) -> dict:
+    """The elastic worker protocol env for one slot incarnation — the ONE
+    place the field set lives (the ssh launcher, ray_elastic and
+    spark.elastic all spawn from it; a field added in only one spawner
+    would make elastic workers silently disagree)."""
+    from . import coordinator_port_for
+    coord_base = coord_base if coord_base is not None else _coord_base()
+    return {
+        _config.HOROVOD_RANK: str(slot.rank),
+        _config.HOROVOD_SIZE: str(slot.size),
+        _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
+        _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
+        _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
+        _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
+        _config.HOROVOD_HOSTNAME: slot.hostname,
+        _config.HOROVOD_RENDEZVOUS_ADDR: addr,
+        _config.HOROVOD_RENDEZVOUS_PORT: str(port),
+        "HOROVOD_ELASTIC": "1",
+        "HVD_TPU_WORLD_VERSION": str(world_version),
+        # Negotiation generation of the spawned world (matches the
+        # survivors' post-refresh value — see elastic._reset).
+        "HVD_TPU_NEGOTIATION_GEN": f"{world_version}.0",
+        # Spawn-time discovery sequence: the notification manager
+        # baselines here so pre-spawn updates are not replayed and
+        # post-spawn ones are never missed.
+        "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
+        # Per-incarnation coordinator port (elastic/__init__.py
+        # coordinator_port_for): every world reshape gets a FRESH
+        # jax.distributed coordination service — reusing a live one
+        # rejects reconnecting tasks ("different incarnation").
+        "HVD_TPU_COORD_BASE": str(coord_base),
+        "HVD_TPU_COORDINATOR":
+            f"{addr}:{coordinator_port_for(coord_base, world_version)}",
+    }
+
+
 def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
     base_env = dict(os.environ)
     base_env.update(env_from_args(args))
@@ -36,33 +73,7 @@ def make_elastic_worker_fn(args, addr: str, port: int, driver) -> Callable:
     def worker_fn(slot: _hosts.SlotInfo, terminate_event: threading.Event,
                   world_version: int):
         env = dict(base_env)
-        env.update({
-            _config.HOROVOD_RANK: str(slot.rank),
-            _config.HOROVOD_SIZE: str(slot.size),
-            _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
-            _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
-            _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
-            _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
-            _config.HOROVOD_HOSTNAME: slot.hostname,
-            _config.HOROVOD_RENDEZVOUS_ADDR: addr,
-            _config.HOROVOD_RENDEZVOUS_PORT: str(port),
-            "HOROVOD_ELASTIC": "1",
-            "HVD_TPU_WORLD_VERSION": str(world_version),
-            # Negotiation generation of the spawned world (matches the
-            # survivors' post-refresh value — see elastic._reset).
-            "HVD_TPU_NEGOTIATION_GEN": f"{world_version}.0",
-            # Spawn-time discovery sequence: the notification manager
-            # baselines here so pre-spawn updates are not replayed and
-            # post-spawn ones are never missed.
-            "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
-            # Per-incarnation coordinator port (elastic/__init__.py
-            # coordinator_port_for): every world reshape gets a FRESH
-            # jax.distributed coordination service — reusing a live one
-            # rejects reconnecting tasks ("different incarnation").
-            "HVD_TPU_COORD_BASE": str(_coord_base()),
-            "HVD_TPU_COORDINATOR":
-                f"{addr}:{_coord_port(world_version)}",
-        })
+        env.update(slot_env(slot, world_version, addr, port, driver))
         prefix = f"[{slot.rank}]<stdout>:"
         cmd = args.command if _is_local(slot.hostname) else \
             _ssh_command(slot, args.command, env, args)

@@ -27,6 +27,7 @@ the same steady-state the reference reaches via its bitvector fast path.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -50,6 +51,19 @@ KIND_IDS = {
     "allgather_sizes": 1001,
     "broadcast": 10000,         # + root rank (unbounded above; own range)
 }
+
+
+def _kv_guarded(fn):
+    """Decorator mapping dead-transport KV errors to HorovodInternalError
+    (see Negotiator._map_transport_error)."""
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        try:
+            return fn(self, *a, **kw)
+        except Exception as e:
+            Negotiator._map_transport_error(e)
+            raise
+    return wrapper
 
 
 class Negotiator:
@@ -100,6 +114,24 @@ class Negotiator:
         from urllib.parse import quote
         return f"rq@{self._gen}@{epoch}@{quote(name, safe='')}"
 
+    @staticmethod
+    def _map_transport_error(e: BaseException) -> None:
+        """Map a dead KV transport to HorovodInternalError so the elastic
+        retry loop owns it (restore last commit → reset → the reset path's
+        rendezvous liveness check converts a dead LAUNCHER into a named
+        RendezvousUnreachableError fail-fast instead of a raw
+        ConnectionRefusedError killing the worker mid-dispatch).  HTTP
+        status errors (server answered) pass through: the server is alive,
+        the request was wrong — a programming error."""
+        import http.client as _http
+        dead = isinstance(e, (ConnectionError, TimeoutError,
+                              _http.HTTPException)) or \
+            (isinstance(e, OSError) and e.errno is not None)
+        if dead:
+            raise HorovodInternalError(
+                f"rendezvous KV unreachable during negotiation: {e}") from e
+
+    @_kv_guarded
     def negotiate(self, name: str, kind: str, dtype: str,
                   shape: Tuple[int, ...], op: int = 0,
                   prescale: float = 1.0, postscale: float = 1.0,
@@ -246,6 +278,7 @@ class Negotiator:
     # rank's seq counter aligned across join rounds.  join() returns the id
     # of the last rank to join, on every rank.
 
+    @_kv_guarded
     def publish_dispatch(self, name: str, epoch: int, sig: dict,
                          kind: str) -> None:
         """Append one replayable record to this rank's dispatch stream
@@ -257,6 +290,7 @@ class Negotiator:
                         f"{self.rank}/{self.dispatch_seq % self._ring}",
                         json.dumps(rec).encode())
 
+    @_kv_guarded
     def poll_dispatch(self, src: int, seq: int) -> Optional[dict]:
         """Record number ``seq`` from ``src``'s stream, or None if not yet
         published.  A newer record in the slot means the publisher lapped
@@ -276,6 +310,7 @@ class Negotiator:
                 f"(ring size {self._ring}; raise HVD_TPU_DISPATCH_RING)")
         return None  # slot still holds an older lap's record
 
+    @_kv_guarded
     def join_active(self) -> bool:
         """True while some rank's join round is open (used by the
         coordinator's broadcast-root check; NOT on the dispatch hot path —
@@ -292,6 +327,7 @@ class Negotiator:
                 out[r] = json.loads(raw)
         return out
 
+    @_kv_guarded
     def announce_join(self, round_: int) -> None:
         self.client.put(f"join@{self._gen}", "active", b"1")
         self.client.put(f"join{round_}@{self._gen}", str(self.rank),

@@ -216,27 +216,10 @@ class ElasticRayExecutor:
             timeout=self.elastic_timeout)
 
     def _worker_env(self, slot: _hosts.SlotInfo, world_version: int) -> Dict:
-        driver = self._driver
+        from .elastic.launch_support import slot_env
         return {
-            _config.HOROVOD_RANK: str(slot.rank),
-            _config.HOROVOD_SIZE: str(slot.size),
-            _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
-            _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
-            _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
-            _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
-            _config.HOROVOD_HOSTNAME: slot.hostname,
-            _config.HOROVOD_RENDEZVOUS_ADDR: self._addr,
-            _config.HOROVOD_RENDEZVOUS_PORT: str(self._port),
-            "HOROVOD_ELASTIC": "1",
-            "HVD_TPU_WORLD_VERSION": str(world_version),
-            "HVD_TPU_NEGOTIATION_GEN": f"{world_version}.0",
-            "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
-            # Fresh coordination service per world incarnation (see
-            # elastic/__init__.py coordinator_port_for).
-            "HVD_TPU_COORD_BASE": str(self._port + 1),
-            "HVD_TPU_COORDINATOR":
-                f"{self._addr}:"
-                f"{coordinator_port_for(self._port + 1, world_version)}",
+            **slot_env(slot, world_version, self._addr, self._port,
+                       self._driver, coord_base=self._port + 1),
             **self.extra_env_vars,
         }
 

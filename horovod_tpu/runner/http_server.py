@@ -71,11 +71,11 @@ class _KVHandler(BaseHTTPRequestHandler):
         # 200-requests/second polling loop (measured: np=16 cached-dispatch
         # p50 went 64 ms -> <2 ms when pollers stopped starving the server).
         wait_s = 0.0
-        if "?" in self.path:
-            from urllib.parse import parse_qs, urlparse
-            q = parse_qs(urlparse(self.path).query)
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(self.path).query)
+        if "wait" in q:
             try:
-                wait_s = min(float(q.get("wait", ["0"])[0]), 60.0)
+                wait_s = min(float(q["wait"][0]), 60.0)
             except ValueError:
                 wait_s = 0.0
         deadline = None
@@ -119,17 +119,31 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         with self.server.cache_lock:
-            self.server.cache.get(self._scope(), {}).pop(self._key(), None)
+            scope_dict = self.server.cache.get(self._scope())
+            if scope_dict is not None:
+                scope_dict.pop(self._key(), None)
+                if not scope_dict:
+                    # GC the emptied scope: per-(name, epoch) negotiation
+                    # scopes would otherwise leak one dict per negotiation
+                    # for the launcher's lifetime.
+                    self.server.cache.pop(self._scope(), None)
         self._empty(200)
 
+    def _path_parts(self):
+        # Path segments are percent-encoded by KVStoreClient, so a literal
+        # '?' or '/' in a scope/key round-trips instead of being parsed as
+        # query/separator; the query (?wait=...) is split off first.
+        from urllib.parse import unquote, urlsplit
+        path = urlsplit(self.path).path
+        return [unquote(p) for p in path.strip("/").split("/")]
+
     def _scope(self) -> str:
-        parts = self.path.strip("/").split("/", 1)[0]
-        return parts.split("?", 1)[0]
+        parts = self._path_parts()
+        return parts[0] if parts else ""
 
     def _key(self) -> str:
-        parts = self.path.strip("/").split("/")
-        key = "/".join(parts[1:]) if len(parts) > 1 else ""
-        return key.split("?", 1)[0]
+        parts = self._path_parts()
+        return "/".join(parts[1:]) if len(parts) > 1 else ""
 
 
 class KVStoreServer:
@@ -225,6 +239,19 @@ class KVStoreClient:
             self._local.conn = conn
         return conn
 
+    @staticmethod
+    def _path(scope: str, key: str = "") -> str:
+        """Percent-encode each segment so scopes/keys with '?', '#', '%',
+        spaces or non-URL bytes round-trip (tensor names are user input);
+        '/' inside keys stays a segment separator, matching the server's
+        split-then-unquote."""
+        from urllib.parse import quote
+        enc = quote(scope, safe="")
+        if key:
+            enc += "/" + "/".join(quote(p, safe="")
+                                  for p in key.split("/"))
+        return "/" + enc
+
     def _request(self, method: str, path: str, body: Optional[bytes] = None):
         import http.client
         for attempt in (0, 1):
@@ -240,7 +267,7 @@ class KVStoreClient:
         raise AssertionError("unreachable")
 
     def put(self, scope: str, key: str, value: bytes):
-        status, _ = self._request("PUT", f"/{scope}/{key}", body=value)
+        status, _ = self._request("PUT", self._path(scope, key), body=value)
         if status >= 400:
             raise OSError(f"KV put {scope}/{key} failed: HTTP {status}")
 
@@ -250,7 +277,7 @@ class KVStoreClient:
         key exists or the wait elapses (then 404 -> None).  One long-poll
         replaces hundreds of poll requests — the difference between a
         healthy and a saturated control plane at np >= 16."""
-        path = f"/{scope}/{key}"
+        path = self._path(scope, key)
         if wait > 0:
             # Stay well under the 30 s client socket timeout.
             path += f"?wait={min(wait, 25.0):.3f}"
@@ -262,14 +289,14 @@ class KVStoreClient:
         return data
 
     def delete(self, scope: str, key: str) -> None:
-        status, _ = self._request("DELETE", f"/{scope}/{key}")
+        status, _ = self._request("DELETE", self._path(scope, key))
         if status >= 400 and status != 404:
             raise OSError(f"KV delete {scope}/{key} failed: HTTP {status}")
 
     def scan(self, scope: str) -> dict:
         """Fetch a whole scope in ONE request: {key: value-bytes}."""
         import base64
-        status, data = self._request("GET", f"/{scope}")
+        status, data = self._request("GET", self._path(scope))
         if status >= 400:
             raise OSError(f"KV scan {scope} failed: HTTP {status}")
         return {k: base64.b64decode(v)

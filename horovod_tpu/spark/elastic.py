@@ -279,6 +279,20 @@ def run_elastic(fn: Callable,
 
     def worker_fn(slot: _hosts.SlotInfo, terminate_event: threading.Event,
                   world_version: int) -> int:
+        # Never raise: the ElasticDriver's worker thread has no except
+        # path, and an escaped KV transport error would leave the Worker
+        # registered forever — driver.join() would hang instead of the
+        # failure being recorded and reshaped around.
+        try:
+            return _worker_fn_inner(slot, terminate_event, world_version)
+        except Exception:
+            get_logger().warning(
+                "spark elastic: worker slot %s:%d failed in the launch "
+                "protocol", slot.hostname, slot.local_rank, exc_info=True)
+            return 1
+
+    def _worker_fn_inner(slot, terminate_event, world_version) -> int:
+        from ..elastic.launch_support import slot_env
         task_id = discovery.task_for_slot(slot.hostname, slot.local_rank)
         if task_id is None:
             return 1  # task vanished between discovery and launch
@@ -286,41 +300,39 @@ def run_elastic(fn: Callable,
             seq = launch_seq.get(task_id, 0)
             launch_seq[task_id] = seq + 1
         wenv = {
-            _config.HOROVOD_RANK: str(slot.rank),
-            _config.HOROVOD_SIZE: str(slot.size),
-            _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
-            _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
-            _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
-            _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
-            _config.HOROVOD_HOSTNAME: slot.hostname,
-            _config.HOROVOD_RENDEZVOUS_ADDR: addr,
-            _config.HOROVOD_RENDEZVOUS_PORT: str(port),
-            "HOROVOD_ELASTIC": "1",
-            "HVD_TPU_WORLD_VERSION": str(world_version),
-            "HVD_TPU_NEGOTIATION_GEN": f"{world_version}.0",
-            "HVD_TPU_DISCOVERY_SEQ": str(getattr(driver, "_update_seq", 0)),
-            "HVD_TPU_COORD_BASE": str(port + 1),
-            "HVD_TPU_COORDINATOR":
-                f"{addr}:{coordinator_port_for(port + 1, world_version)}",
+            **slot_env(slot, world_version, addr, port, driver,
+                       coord_base=port + 1),
             **extra_env,
         }
         client.put(_SCOPE_LAUNCH, f"cmd/{task_id}/{seq}",
                    json.dumps({"env": wenv}).encode())
-        while True:
-            raw = client.get(_SCOPE_DONE, f"done/{task_id}/{seq}", wait=1.0)
-            if raw is not None:
-                return int(json.loads(raw)["code"])
-            if terminate_event.is_set():
-                client.put(_SCOPE_LAUNCH, f"abort/{task_id}/{seq}", b"1")
+        try:
+            while True:
                 raw = client.get(_SCOPE_DONE, f"done/{task_id}/{seq}",
-                                 wait=10.0)
-                return int(json.loads(raw)["code"]) if raw else 143
-            if discovery.task_for_slot(slot.hostname,
-                                       slot.local_rank) != task_id:
-                get_logger().warning(
-                    "spark elastic: task %d (slot %s:%d) lost mid-run",
-                    task_id, slot.hostname, slot.local_rank)
-                return 1
+                                 wait=1.0)
+                if raw is not None:
+                    return int(json.loads(raw)["code"])
+                if terminate_event.is_set():
+                    client.put(_SCOPE_LAUNCH, f"abort/{task_id}/{seq}",
+                               b"1")
+                    raw = client.get(_SCOPE_DONE, f"done/{task_id}/{seq}",
+                                     wait=10.0)
+                    return int(json.loads(raw)["code"]) if raw else 143
+                if discovery.task_for_slot(slot.hostname,
+                                           slot.local_rank) != task_id:
+                    get_logger().warning(
+                        "spark elastic: task %d (slot %s:%d) lost mid-run",
+                        task_id, slot.hostname, slot.local_rank)
+                    return 1
+        finally:
+            # Consume the records: a Spark-rescheduled incarnation of this
+            # task must not replay completed/aborted launches (it resumes
+            # at the first seq with neither marker — see task_pool_loop).
+            for k in (f"cmd/{task_id}/{seq}", f"abort/{task_id}/{seq}"):
+                try:
+                    client.delete(_SCOPE_LAUNCH, k)
+                except Exception:
+                    pass
 
     t0 = time.time()
     while not discovery.find_available_hosts_and_slots():
