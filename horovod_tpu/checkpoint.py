@@ -21,18 +21,41 @@ from . import functions as _functions
 
 def _ckptr():
     import orbax.checkpoint as ocp
+    if jax.process_count() > 1:
+        # Rank-0-writes convention: only the CALLING process participates
+        # in the save/restore.  Orbax's default save()/restore() run
+        # multihost sync barriers spanning every process; with only rank 0
+        # inside orbax and the other ranks waiting at OUR release barrier,
+        # the two barriers deadlock (30 s Gloo DEADLINE_EXCEEDED).  Scope
+        # orbax's sync to this process alone.
+        from orbax.checkpoint import options as _opts
+        idx = jax.process_index()
+        return ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(),
+            multiprocessing_options=_opts.MultiprocessingOptions(
+                primary_host=idx, active_processes={idx}))
     return ocp.PyTreeCheckpointer()
 
 
-def save(path: str, state: Any, force: bool = True) -> None:
+def save(path: str, state: Any, force: bool = True,
+         _rank0_post=None) -> None:
     """Write ``state`` (pytree) from rank 0 only; other ranks no-op and
-    wait at a barrier so nobody races ahead of an incomplete write."""
+    wait at a barrier so nobody races ahead of an incomplete write.
+    ``_rank0_post`` runs on rank 0 after the write but BEFORE the barrier,
+    so sidecar files are in place before any rank is released to read."""
     from . import ops as _ops
-    if _core.rank() == 0:
-        _ckptr().save(os.path.abspath(path), jax.device_get(state),
-                      force=force)
-    if _core.size() > 1 and not _core._require_init().topology.emulated:
-        _ops.barrier()
+    try:
+        if _core.rank() == 0:
+            _ckptr().save(os.path.abspath(path), jax.device_get(state),
+                          force=force)
+            if _rank0_post is not None:
+                _rank0_post()
+    finally:
+        # The barrier must run even when the rank-0 write raises: the
+        # other ranks are already blocking in it (no timeout), so skipping
+        # it would turn a local write failure into a distributed hang.
+        if _core.size() > 1 and not _core._require_init().topology.emulated:
+            _ops.barrier()
 
 
 def save_model(path: str, params: Any, opt_state: Any = None,
@@ -42,15 +65,19 @@ def save_model(path: str, params: Any, opt_state: Any = None,
     the analog of saving a Keras model whose optimizer weights ride along
     (reference keras/__init__.py:268 load_model contract).  Rank-0-writes
     semantics of :func:`save` apply."""
-    save(path, {"params": params, "opt_state": opt_state})
-    if _core.rank() == 0:
+    def write_sidecar():
         # Metadata rides NEXT TO the orbax tree (not inside it): arbitrary
         # user dicts would force restore templates to predeclare their
         # structure; a JSON sidecar + broadcast_object on load avoids that.
+        # Written before save()'s barrier releases the other ranks, so a
+        # coordinated immediate load_model always sees it.
         import json
         with open(os.path.join(os.path.abspath(path), "extra.json"),
                   "w") as f:
             json.dump(extra or {}, f)
+
+    save(path, {"params": params, "opt_state": opt_state},
+         _rank0_post=write_sidecar)
 
 
 def load_model(path: str, optimizer=None, params_template: Any = None,

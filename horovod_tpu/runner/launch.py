@@ -90,6 +90,11 @@ def parse_args(argv=None):
     group_controller.add_argument("--mpi", "--use-mpi", dest="use_mpi",
                                   action="store_true",
                                   help="Compatibility no-op (single backend).")
+    group_controller.add_argument("--jsrun", "--use-jsrun",
+                                  dest="use_jsrun",
+                                  action="store_true",
+                                  help="LSF/jsrun launch (unsupported; "
+                                       "errors with a migration pointer).")
 
     group_params = parser.add_argument_group("tuneable parameter arguments")
     group_params.add_argument("--fusion-threshold-mb", action=Store,
@@ -167,6 +172,23 @@ def parse_args(argv=None):
 
     args = parser.parse_args(argv)
     args.override_args = override_args
+    # Honest no-op/unsupported handling (reference launch.py:747
+    # run_controller chooses gloo/mpi/jsrun; here there is exactly one
+    # backend).  Silent acceptance would let an --mpi user assume mpirun
+    # semantics they are not getting.
+    if args.use_jsrun:
+        parser.error(
+            "jsrun/LSF launch is not supported: this framework has one "
+            "communication backend (XLA collectives) and one launcher "
+            "(ssh/loopback). Submit horovodrun inside the LSF job script "
+            "with -H/--hostfile instead — see docs/migration.md "
+            "(launchers table).")
+    if args.use_mpi or args.use_gloo:
+        flag = "--mpi" if args.use_mpi else "--gloo"
+        print(f"horovodrun: note: {flag} is accepted for compatibility and "
+              "ignored — workers always launch over ssh/loopback with the "
+              "single XLA collective backend (see docs/migration.md).",
+              file=sys.stderr)
     if args.config_file:
         _apply_config_file(args)
     return args

@@ -134,6 +134,39 @@ def task_pool_loop(addr: str, port: int, task_index: int,
                           name=f"se-heartbeat-{task_index}")
     hb.start()
     seq = 0
+
+    def reconcile(seq: int) -> int:
+        """A Spark-rescheduled incarnation restarts at seq=0 while the
+        driver's counter kept going (completed launches' cmd records are
+        deleted on consumption) — without this it would long-poll a seq
+        that will never be written again.  The driver publishes
+        ``next/{task}`` AFTER each cmd put, so: read next first, then scan
+        for pending cmds.  A pending cmd >= seq is served; otherwise, if
+        next says the counter is ahead AND no cmd for the gap survives
+        (i.e. those launches were consumed), jump the counter forward."""
+        try:
+            nxt_raw = client.get(_SCOPE_LAUNCH, f"next/{task_index}")
+            pending = sorted(
+                int(k.rsplit("/", 1)[1])
+                for k in client.scan(_SCOPE_LAUNCH)
+                if k.startswith(f"cmd/{task_index}/"))
+        except Exception:
+            return seq
+        ahead = [s for s in pending if s >= seq]
+        if ahead:
+            return ahead[0]
+        if nxt_raw is not None:
+            nxt = int(nxt_raw)
+            if nxt > seq:
+                return nxt
+        return seq
+
+    # After the first served cmd, seq provably tracks the driver's counter
+    # (the loop increments it after every done), so steady-state reconcile
+    # is a no-op; back off exponentially rather than scanning the scope on
+    # every 1 s poll timeout — the rendezvous server's long-poll design
+    # exists precisely to avoid that per-second load at scale.
+    backoff, next_reconcile = 1.0, 0.0
     try:
         while True:
             if client.get(_SCOPE_CTL, "shutdown") is not None:
@@ -141,7 +174,15 @@ def task_pool_loop(addr: str, port: int, task_index: int,
             raw = client.get(_SCOPE_LAUNCH, f"cmd/{task_index}/{seq}",
                              wait=1.0)
             if raw is None:
+                now = time.monotonic()
+                if now >= next_reconcile:
+                    new_seq = reconcile(seq)
+                    backoff = 1.0 if new_seq != seq else min(backoff * 2,
+                                                             30.0)
+                    seq = new_seq
+                    next_reconcile = now + backoff
                 continue
+            backoff, next_reconcile = 1.0, 0.0
             cmd = json.loads(raw)
             env = dict(os.environ)
             env.update(cmd["env"])
@@ -168,6 +209,15 @@ def task_pool_loop(addr: str, port: int, task_index: int,
                         break
             client.put(_SCOPE_DONE, f"done/{task_index}/{seq}",
                        json.dumps({"code": code}).encode())
+            if client.get(_SCOPE_LAUNCH, f"cmd/{task_index}/{seq}") is None:
+                # The driver abandoned this launch (its abort wait timed
+                # out and cleanup deleted cmd before our done landed):
+                # nobody will ever consume the marker — drop it so aborts
+                # can't leak KV keys for the run's lifetime.
+                try:
+                    client.delete(_SCOPE_DONE, f"done/{task_index}/{seq}")
+                except Exception:
+                    pass
             seq += 1
     finally:
         stop.set()
@@ -275,7 +325,24 @@ def run_elastic(fn: Callable,
 
     launch_seq: Dict[int, int] = {}     # task_id -> next launch seq
     seq_lock = threading.Lock()
+    task_locks: Dict[int, threading.Lock] = {}  # per-task launch ordering
     extra_env = dict(env or {})
+    gc_state = {"version": -1}
+
+    def _gc_stale_results(world_version: int) -> None:
+        """Results of superseded worlds are never read (only the FINAL
+        world's are returned); drop them on each reshape so a long
+        elastic run doesn't grow the launcher's KV store without bound."""
+        with seq_lock:
+            if world_version <= gc_state["version"]:
+                return
+            gc_state["version"] = world_version
+        try:
+            for k in client.scan(_SCOPE_RESULTS):
+                if int(k.split("/")[0]) < world_version:
+                    client.delete(_SCOPE_RESULTS, k)
+        except Exception:
+            pass
 
     def worker_fn(slot: _hosts.SlotInfo, terminate_event: threading.Event,
                   world_version: int) -> int:
@@ -293,20 +360,37 @@ def run_elastic(fn: Callable,
 
     def _worker_fn_inner(slot, terminate_event, world_version) -> int:
         from ..elastic.launch_support import slot_env
+        _gc_stale_results(world_version)
         task_id = discovery.task_for_slot(slot.hostname, slot.local_rank)
         if task_id is None:
             return 1  # task vanished between discovery and launch
-        with seq_lock:
-            seq = launch_seq.get(task_id, 0)
-            launch_seq[task_id] = seq + 1
         wenv = {
             **slot_env(slot, world_version, addr, port, driver,
                        coord_base=port + 1),
             **extra_env,
         }
-        client.put(_SCOPE_LAUNCH, f"cmd/{task_id}/{seq}",
-                   json.dumps({"env": wenv}).encode())
+        with seq_lock:
+            tlock = task_locks.setdefault(task_id, threading.Lock())
+        seq = None
         try:
+            # Alloc + both puts under a PER-TASK lock: cmd must precede
+            # next and next must be monotonic *per task* (a rescheduled
+            # incarnation's reconcile() reads next first, then scans
+            # pending cmds — seeing next==seq+1 with no cmd/{seq} pending
+            # proves launch seq was already consumed and skipping it is
+            # safe).  Cross-task launches stay parallel; a hung KV request
+            # stalls only this task's launch, not the whole reshape.  The
+            # puts sit inside the try so a put failure after cmd landed
+            # still reaches the finally's cleanup — otherwise the task
+            # loop would serve a launch no worker thread tracks.
+            with tlock:
+                with seq_lock:
+                    seq = launch_seq.get(task_id, 0)
+                    launch_seq[task_id] = seq + 1
+                client.put(_SCOPE_LAUNCH, f"cmd/{task_id}/{seq}",
+                           json.dumps({"env": wenv}).encode())
+                client.put(_SCOPE_LAUNCH, f"next/{task_id}",
+                           str(seq + 1).encode())
             while True:
                 raw = client.get(_SCOPE_DONE, f"done/{task_id}/{seq}",
                                  wait=1.0)
@@ -326,13 +410,20 @@ def run_elastic(fn: Callable,
                     return 1
         finally:
             # Consume the records: a Spark-rescheduled incarnation of this
-            # task must not replay completed/aborted launches (it resumes
-            # at the first seq with neither marker — see task_pool_loop).
-            for k in (f"cmd/{task_id}/{seq}", f"abort/{task_id}/{seq}"):
-                try:
-                    client.delete(_SCOPE_LAUNCH, k)
-                except Exception:
-                    pass
+            # task must not replay completed/aborted launches (its
+            # reconcile() skips forward using the next/{task} pointer once
+            # the cmd is gone — see task_pool_loop).  done/ is consumed
+            # too so a long-elastic run's KV store stays bounded; a done
+            # marker that lands AFTER this cleanup (slow-dying abortee) is
+            # dropped by the task loop's own cmd-gone check.
+            if seq is not None:
+                for scope, k in ((_SCOPE_LAUNCH, f"cmd/{task_id}/{seq}"),
+                                 (_SCOPE_LAUNCH, f"abort/{task_id}/{seq}"),
+                                 (_SCOPE_DONE, f"done/{task_id}/{seq}")):
+                    try:
+                        client.delete(scope, k)
+                    except Exception:
+                        pass
 
     t0 = time.time()
     while not discovery.find_available_hosts_and_slots():

@@ -1,10 +1,60 @@
 """Checkpoint helpers: rank-0 save + broadcast restore (SURVEY.md §5.4)."""
 
+import os
+import subprocess
+import sys
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import horovod_tpu as hvd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MULTIPROC_WORKER = '''
+import os
+import sys
+sys.path.insert(0, r"{repo}")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import optax
+import horovod_tpu as hvd
+
+hvd.init()
+path = os.path.join(r"{ckpt_dir}", "model")
+params = {{"w": jax.numpy.ones((4,)) * (1.0 if hvd.rank() == 0 else 99.0)}}
+base = optax.sgd(0.1, momentum=0.9)
+opt = hvd.DistributedOptimizer(base)
+opt_state = opt.init(params)
+# rank 0 writes; the extra.json sidecar must exist before ANY rank is
+# released from save's barrier, so the coordinated immediate load sees it.
+hvd.checkpoint.save_model(path, params, opt_state, extra={{"epoch": 7}})
+p, o, os_, extra = hvd.checkpoint.load_model(path, optimizer=base,
+                                             params_template=params)
+assert extra == {{"epoch": 7}}, f"rank {{hvd.rank()}} got extra={{extra}}"
+assert float(p["w"][0]) == 1.0, "did not adopt rank 0 params"
+print(f"CKPT_OK rank={{hvd.rank()}}")
+'''
+
+
+@pytest.mark.integration
+def test_save_model_load_model_two_processes(tmp_path):
+    """Real 2-process world (launcher + jax.distributed): rank-0-only
+    orbax write must not deadlock against the release barrier (orbax's own
+    multihost sync is scoped to the writing process — see _ckptr), and the
+    sidecar is visible to the immediate coordinated load on both ranks."""
+    script = tmp_path / "ckpt_worker.py"
+    script.write_text(MULTIPROC_WORKER.format(ckpt_dir=str(tmp_path),
+                                              repo=REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CKPT_OK rank=0" in proc.stdout
+    assert "CKPT_OK rank=1" in proc.stdout
 
 
 def test_save_restore_roundtrip(tmp_path, hvd8):

@@ -85,6 +85,31 @@ def test_gloo_mpi_flags_mutually_exclusive():
         parse_args(["-np", "1", "--gloo", "--mpi", "python", "x.py"])
 
 
+def test_mpi_gloo_noop_flags_warn(capsys):
+    """--mpi/--gloo are single-backend no-ops but must SAY so (reference
+    launch.py:747 run_controller chooses a real backend; silence would
+    imply mpirun semantics)."""
+    parse_args(["-np", "1", "--mpi", "python", "x.py"])
+    err = capsys.readouterr().err
+    assert "--mpi is accepted for compatibility and ignored" in err
+    assert "docs/migration.md" in err
+    parse_args(["-np", "1", "--gloo", "python", "x.py"])
+    err = capsys.readouterr().err
+    assert "--gloo is accepted for compatibility and ignored" in err
+
+
+def test_jsrun_flag_errors_with_migration_pointer(capsys):
+    """LSF/jsrun launch (reference runner/js_run.py:32) is out of scope by
+    design; the launcher must fail loudly with the migration pointer, not
+    silently fall back to ssh."""
+    with pytest.raises(SystemExit) as ei:
+        parse_args(["-np", "1", "--jsrun", "python", "x.py"])
+    assert ei.value.code == 2
+    err = capsys.readouterr().err
+    assert "jsrun/LSF launch is not supported" in err
+    assert "docs/migration.md" in err
+
+
 # -- host assignment (hosts.py:100) -----------------------------------------
 
 def test_parse_hosts():

@@ -103,6 +103,64 @@ def test_run_elastic_multi_host_assignment():
     assert results == [(0, 2), (1, 2)]
 
 
+@pytest.mark.integration
+def test_rescheduled_incarnation_resumes_at_driver_counter(tmp_path):
+    """A Spark-rescheduled task incarnation restarts task_pool_loop at
+    seq=0 while the driver's launch counter is ahead and the consumed
+    launches' cmd records are gone.  The loop must reconcile forward via
+    the next/{task} pointer and serve the next launch instead of
+    long-polling cmd/{task}/0 forever (round-3 advisor finding)."""
+    import json
+    import time
+
+    import cloudpickle
+
+    from horovod_tpu.runner.http_server import (KVStoreClient,
+                                                RendezvousServer)
+    from horovod_tpu.spark import elastic as se
+
+    server = RendezvousServer()
+    port = server.start()
+    client = KVStoreClient("127.0.0.1", port)
+    out = str(tmp_path / "ran")
+    try:
+        def fn():
+            open(out, "w").write("ok")
+            return 0
+
+        client.put(se._SCOPE_FN, "blob", cloudpickle.dumps((fn, (), {})))
+        # History: launches 0..2 were consumed (cmd deleted, next=3).
+        client.put(se._SCOPE_LAUNCH, "next/0", b"3")
+
+        th = threading.Thread(target=task_pool_loop,
+                              args=("127.0.0.1", port, 0),
+                              daemon=True, name="se-task-reinc")
+        th.start()
+        # Give the fresh incarnation a moment to start polling at seq=0,
+        # then publish the post-reshape launch at the driver's counter.
+        time.sleep(1.5)
+        env = {"HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+               "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+               "HVD_TPU_WORLD_VERSION": "1", "HOROVOD_RANK": "0"}
+        client.put(se._SCOPE_LAUNCH, "cmd/0/3",
+                   json.dumps({"env": env}).encode())
+        client.put(se._SCOPE_LAUNCH, "next/0", b"4")
+
+        deadline = time.time() + 45
+        done = None
+        while time.time() < deadline and done is None:
+            done = client.get(se._SCOPE_DONE, "done/0/3")
+            time.sleep(0.25)
+        assert done is not None, \
+            "rescheduled incarnation never served the seq-3 launch"
+        assert json.loads(done)["code"] == 0
+        assert os.path.exists(out)
+    finally:
+        client.put(se._SCOPE_CTL, "shutdown", b"1")
+        th.join(timeout=10)
+        server.stop()
+
+
 def test_discovery_groups_by_host_and_windows_heartbeats():
     import json
     import time

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Opportunistic TPU bench capture: probe the relay on a loop; the moment it
+# answers, run the full bench battery (ResNet fast-stem + naive-stem, BERT
+# dense vs flash attention) and persist every capture via bench.py's
+# last-good mechanism.  Logs to artifacts/opportunistic_capture.log.
+#
+# Motivated by VERDICT r3 Missing #1: three rounds of driver-time relay
+# outages zeroed the official perf record; captures must happen whenever the
+# relay is up, not only at driver time.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/opportunistic_capture.log
+mkdir -p artifacts
+echo "=== opportunistic capture watcher started $(date -u +%FT%TZ) ===" >> "$LOG"
+
+probe() {
+    timeout 90 python -c "import jax; assert jax.devices()" >/dev/null 2>&1
+}
+
+while true; do
+    if probe; then
+        echo "--- relay up $(date -u +%FT%TZ); running battery ---" >> "$LOG"
+        # 1. ResNet-50 fast stem (the driver's default invocation).
+        # stdout goes to its own file: bench.py's stale-fallback ALSO
+        # exits 0 (driver contract), so rc alone can't distinguish a
+        # fresh capture from a stale emission — check the JSON too.
+        OUT=artifacts/capture_resnet_fast.out
+        timeout 1200 env BENCH_PROBE_BUDGET_S=120 python bench.py \
+            > "$OUT" 2>> "$LOG"
+        rc1=$?
+        cat "$OUT" >> "$LOG"
+        if [ "$rc1" -eq 0 ] && grep -q '"stale": true' "$OUT"; then
+            rc1=99   # stale emission, not a fresh capture: keep looping
+        fi
+        # 2. ResNet-50 naive stem (for the s2d ablation in PERF_r04.md)
+        timeout 1200 env BENCH_PROBE_BUDGET_S=120 BENCH_FAST_STEM=0 \
+            HVD_TPU_BENCH_TAG=naive python bench.py \
+            >> artifacts/capture_resnet_naive.log 2>&1
+        rc2=$?
+        # 3. BERT-large dense attention
+        timeout 1800 env BENCH_PROBE_BUDGET_S=120 BENCH_MODEL=bert-large \
+            BENCH_BERT_ATTN=dense python bench.py \
+            >> artifacts/capture_bert_dense.log 2>&1
+        rc3=$?
+        # 4. BERT-large flash attention (Pallas kernel — first real-TPU run)
+        timeout 1800 env BENCH_PROBE_BUDGET_S=120 BENCH_MODEL=bert-large \
+            BENCH_BERT_ATTN=flash python bench.py \
+            >> artifacts/capture_bert_flash.log 2>&1
+        rc4=$?
+        echo "--- battery done rc=($rc1,$rc2,$rc3,$rc4) $(date -u +%FT%TZ) ---" >> "$LOG"
+        if [ "$rc1" -eq 0 ]; then
+            echo "=== capture complete; watcher exiting ===" >> "$LOG"
+            exit 0
+        fi
+    else
+        echo "probe failed $(date -u +%FT%TZ)" >> "$LOG"
+    fi
+    sleep 120
+done
