@@ -265,6 +265,9 @@ def shutdown() -> None:
         _state.mesh = None
         _state.topology = None
         _state.process_set_table = None
+        eng = _state.eager_engine
+        if eng is not None and eng._negotiator is not None:
+            eng._negotiator.close()  # stop flusher, ship pending records
         _state.eager_engine = None
 
 

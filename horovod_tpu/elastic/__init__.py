@@ -41,6 +41,8 @@ from .sampler import ElasticSampler  # noqa: F401
 from .driver import ElasticDriver  # noqa: F401
 from .discovery import (  # noqa: F401
     HostDiscovery, HostDiscoveryScript, FixedHostDiscovery, HostManager)
+from .preemption import (  # noqa: F401
+    PreemptionAwareDiscovery, PreemptionSentinel)
 
 
 class WorkerNotificationManager:
@@ -58,6 +60,7 @@ class WorkerNotificationManager:
         self._stop = threading.Event()
         self._seen_version = 0
         self._lock = threading.Lock()
+        self._sentinel = None
 
     def init(self):
         if self._thread is not None:
@@ -111,6 +114,15 @@ class WorkerNotificationManager:
         self._thread = threading.Thread(target=poll, daemon=True,
                                         name="hvd-worker-notify")
         self._thread.start()
+        # TPU-VM preemption sentinel: polls this host's metadata
+        # maintenance-event endpoint and publishes a drain marker the
+        # driver's PreemptionAwareDiscovery consumes (elastic/preemption.py).
+        # Cheap (one 2 s-timeout HTTP poll per 5 s, a fast failure off
+        # GCP); disable with HVD_TPU_PREEMPTION_SENTINEL=0.
+        if os.environ.get("HVD_TPU_PREEMPTION_SENTINEL", "1") == "1":
+            from .preemption import PreemptionSentinel
+            self._sentinel = PreemptionSentinel(client)
+            self._sentinel.start()
 
     def register_listener(self, state: State):
         with self._lock:
@@ -239,6 +251,21 @@ def _refresh_world_from_rendezvous(allow_same_world: bool = False) -> str:
                             "elastic: no slot for (%s, %s) in world v%s — "
                             "scaled out, exiting", hostname, local_rank,
                             world["version"])
+                        # Leave the coordination service NOW (bounded):
+                        # the surviving ranks' resets are waiting at the
+                        # old runtime's shutdown barrier, which needs
+                        # every task — exiting without this made them
+                        # burn the barrier deadline and F-abort whenever
+                        # this worker was slow to die.
+                        try:
+                            import jax
+                            from jax._src import distributed as _jd
+                            if getattr(_jd.global_state, "client",
+                                       None) is not None:
+                                jax.distributed.shutdown()
+                        except Exception as e:
+                            get_logger().debug(
+                                "scaled-out jax shutdown: %s", e)
                         raise SystemExit(0)
                 else:
                     os.environ[_config.HOROVOD_RANK] = str(rec["rank"])
@@ -513,10 +540,17 @@ def run(func):
                         escalated = False  # confirmed membership change
                 if reset_required:
                     try:
-                        # escalated=True marks refreshes adopted on the
-                        # retry heuristic (not a confirmed host change):
-                        # those may fall back to in-place when the world
-                        # version never actually moved.
+                        # The driver only notifies when a reshape IS
+                        # coming (no-op additive discoveries are
+                        # suppressed, driver.py _discover_loop), so the
+                        # interrupt path waits for the new version rather
+                        # than racing it with an in-place fallback — a
+                        # premature same-world reset during a real
+                        # scale-up strands the new worker at the init
+                        # barrier.  escalated=True marks refreshes adopted
+                        # on the retry heuristic (not a confirmed host
+                        # change): those may fall back to in-place when
+                        # the world version never actually moved.
                         _reset(refresh_world=refresh_world,
                                allow_same_world=escalated)
                     except Exception as e:
@@ -592,7 +626,7 @@ def run(func):
                         "elastic: host membership changed — reinitializing")
                     skip_sync = e.skip_sync
                     refresh_world = True
-                    escalated = False  # confirmed change: a new version WILL come
+                    escalated = False
                 reset_required = True
         finally:
             notification_manager.remove_listener(state)

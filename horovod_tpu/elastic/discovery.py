@@ -67,12 +67,14 @@ class Blacklist:
         self._cooldown_range = cooldown_range
         self._failures: Dict[str, int] = {}
         self._until: Dict[str, float] = {}
+        self._since: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     def blacklist(self, host: str) -> None:
         with self._lock:
             count = self._failures.get(host, 0) + 1
             self._failures[host] = count
+            self._since[host] = time.time()
             if self._cooldown_range is None:
                 self._until[host] = float("inf")
                 return
@@ -90,6 +92,16 @@ class Blacklist:
                 del self._until[host]
                 return False
             return True
+
+    def blacklisted_since(self, host: str) -> float:
+        with self._lock:
+            return self._since.get(host, 0.0)
+
+    def forgive(self, host: str) -> None:
+        """Lift the entry (failure count is kept: a re-blacklist cools
+        down longer)."""
+        with self._lock:
+            self._until.pop(host, None)
 
     def count(self, host: str) -> int:
         return self._failures.get(host, 0)
@@ -110,9 +122,23 @@ class HostManager:
         """Refresh from discovery; returns change code: 0 = no change or
         pure scale-up, 1 = hosts removed (requires sync).  Mirrors the
         reference's HostUpdateResult semantics."""
-        found = self.discovery.find_available_hosts_and_slots()
-        found = {h: s for h, s in found.items()
+        found_all = self.discovery.find_available_hosts_and_slots()
+        found = {h: s for h, s in found_all.items()
                  if not self.blacklist.is_blacklisted(h)}
+        if not found and found_all:
+            # Pool starvation: every discoverable host is blacklisted.  A
+            # permanent blacklist (no --blacklist-cooldown-range) would
+            # guarantee job death on a single-host pool — e.g. a reshape's
+            # shutdown-barrier abort killing all of localhost's workers at
+            # once.  Readmit the least-recently-blacklisted host and let
+            # --reset-limit bound genuine crash loops.
+            h = min(found_all, key=self.blacklist.blacklisted_since)
+            get_logger().warning(
+                "all discoverable hosts blacklisted; readmitting %r "
+                "(pool-starvation escape; --reset-limit still bounds "
+                "crash loops)", h)
+            self.blacklist.forgive(h)
+            found[h] = found_all[h]
         with self._lock:
             prev = self.current_hosts
             removed = [h for h in prev if h not in found]

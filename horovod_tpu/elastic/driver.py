@@ -69,6 +69,19 @@ class ElasticDriver:
                  timeout: float = 600.0,
                  verbose: bool = False):
         self.rendezvous = rendezvous
+        # Preemption awareness (SURVEY §5.3 TPU equivalent): worker-host
+        # sentinels publish maintenance notices into the rendezvous KV
+        # scope "preempt"; wrapping the discovery filters those hosts out
+        # of the discoverable world so the reshape happens BEFORE the VM
+        # dies, and _terminate_workers_on_lost_hosts drains their workers
+        # gracefully instead of terminating them.
+        from .preemption import PREEMPT_SCOPE, PreemptionAwareDiscovery
+
+        def _marked_hosts():
+            return set(rendezvous.scan_scope(PREEMPT_SCOPE).keys())
+
+        self._preempt_marked = _marked_hosts
+        discovery = PreemptionAwareDiscovery(discovery, _marked_hosts)
         self.host_manager = HostManager(discovery, cooldown_range)
         self.min_np = min_np
         self.max_np = max_np or min_np
@@ -83,6 +96,7 @@ class ElasticDriver:
         self._error_message: Optional[str] = None
         self._resumes_inflight = 0
         self._resume_pending = False
+        self._resume_rerun = False
         self._lock = threading.RLock()
         self._worker_cmd_fn: Optional[Callable] = None
         self._discovery_thread = threading.Thread(
@@ -141,6 +155,13 @@ class ElasticDriver:
     def world_version(self) -> int:
         return self._world_version
 
+    @property
+    def resume_in_flight(self) -> bool:
+        """True while a world reshape is pending or being applied (used by
+        the registry to classify worker deaths as reshape casualties)."""
+        with self._lock:
+            return self._resume_pending or self._resumes_inflight > 0
+
     def current_assignments(self) -> List[_hosts.SlotInfo]:
         with self._lock:
             return list(self._assignments)
@@ -154,27 +175,52 @@ class ElasticDriver:
             except Exception as e:  # discovery script hiccup: keep going
                 get_logger().warning("discovery failed: %s", e)
                 res = 0
-            if res:
+            if res == 1:
+                # Hosts removed: terminate their workers and reshape the
+                # world so survivors re-rendezvous into fresh records.
                 self._notify_workers_host_changes(res)
-                if res == 1:
-                    # Hosts removed: terminate their workers and reshape the
-                    # world so survivors re-rendezvous into fresh records.
-                    self._terminate_workers_on_lost_hosts()
-                    self.request_resume(additive=False, count_reset=True)
-                elif res == 2 and self.host_manager.available_slots > \
+                self._terminate_workers_on_lost_hosts()
+                self.request_resume(additive=False, count_reset=True)
+            elif res == 2:
+                if self.host_manager.available_slots > \
                         len(self._assignments) and \
                         len(self._assignments) < self.max_np:
                     # Pure scale-up: workers will interrupt & re-rendezvous
                     # at next commit; prepare the new world eagerly.
+                    self._notify_workers_host_changes(res)
                     self.request_resume(additive=True, count_reset=False)
+                # else: an additive discovery result the driver will NOT
+                # act on — e.g. a blacklisted host re-appearing after its
+                # cooldown while the world is already at capacity.  Do NOT
+                # notify: the interrupt would send every worker into a
+                # re-rendezvous for a world version that is never coming
+                # (this exact wedge deadlocked the crash-recovery e2e
+                # whenever the blacklist cooldown re-added the host).
             self._shutdown.wait(DISCOVER_INTERVAL_S)
 
     def _terminate_workers_on_lost_hosts(self):
+        marked = self._preempt_marked()
         with self._lock:
             current = set(self.host_manager.current_hosts.keys())
             for (host, slot), w in self._workers.items():
                 if host not in current:
-                    w.terminate_event.set()
+                    if host in marked:
+                        # Preempt-marked host: still ALIVE, dying soon.
+                        # Give its worker a drain window — the discovery
+                        # notification (published just before this call)
+                        # raises HostsUpdatedInterrupt at the worker's
+                        # next commit, so state lands on disk/peers before
+                        # the reshape; terminate is only the grace-period
+                        # fallback.  decommissioned=True keeps the exit
+                        # from being recorded as a failure (no blacklist:
+                        # the marker itself keeps the host out).
+                        if not w.decommissioned:
+                            w.decommissioned = True
+                            w.decommission_timer = threading.Timer(
+                                DECOMMISSION_GRACE_S, w.terminate_event.set)
+                            w.decommission_timer.start()
+                    else:
+                        w.terminate_event.set()
 
     def _notify_workers_host_changes(self, update_res: int):
         """KV-store sequence bump — worker poll threads pick it up
@@ -312,11 +358,20 @@ class ElasticDriver:
                        count_reset: bool = True) -> bool:
         """Schedule one world reshape; concurrent requests coalesce.
         Returns True when a new resume was scheduled (used by the registry
-        to count resets per reshape, not per failed worker)."""
+        to count resets per reshape, not per failed worker).
+
+        A request that lands while a resume is already running is NOT
+        dropped: it marks the running resume for a re-run.  Every
+        notification promises the workers a world-version bump (their
+        refresh blocks on one); silently absorbing a second host change
+        into an in-flight reshape left them waiting for a version that
+        never came (two discovery updates 12 s apart under load wedged the
+        scale-down e2e this way)."""
         if self._shutdown.is_set():
             return False
         with self._lock:
             if self._resume_pending:
+                self._resume_rerun = True
                 return False
             self._resume_pending = True
             self._resumes_inflight += 1
@@ -325,20 +380,41 @@ class ElasticDriver:
         return True
 
     def _resume(self, additive: bool) -> None:
-        """Reshape the world after failure or scale-up (driver.py:304)."""
+        """Reshape the world after failure or scale-up (driver.py:304);
+        loops while coalesced requests arrived mid-reshape."""
+        closed_out = False
         try:
-            try:
-                self.wait_for_available_slots(self.min_np)
-            except RuntimeError as e:
-                self.stop(error_message=str(e))
-                return
-            if self._shutdown.is_set():
-                return
-            self._activate_world()
+            while True:
+                try:
+                    self.wait_for_available_slots(self.min_np)
+                except RuntimeError as e:
+                    self.stop(error_message=str(e))
+                    return
+                if self._shutdown.is_set():
+                    return
+                self._activate_world()
+                with self._lock:
+                    if not self._resume_rerun:
+                        # Close out ATOMICALLY with the rerun check: a
+                        # request landing after this lock release sees
+                        # pending=False and schedules its own resume.
+                        # (Clearing rerun in a separate finally dropped a
+                        # request that coalesced between the check and
+                        # the finally — the silent-swallow this loop
+                        # exists to prevent.)
+                        self._resume_pending = False
+                        self._resumes_inflight -= 1
+                        closed_out = True
+                        return
+                    self._resume_rerun = False
         finally:
-            with self._lock:
-                self._resume_pending = False
-                self._resumes_inflight -= 1
+            if not closed_out:
+                # stop/shutdown/exception paths: the job is ending (or the
+                # driver stopped); dropping a pending rerun is correct.
+                with self._lock:
+                    self._resume_pending = False
+                    self._resume_rerun = False
+                    self._resumes_inflight -= 1
 
     # Back-compat spelling used in docs/tests.
     def resume(self, additive: bool = False) -> None:

@@ -49,8 +49,21 @@ class WorkerStateRegistry:
         ``version`` is the world generation the worker was launched into;
         failures from a world that has already been reshaped past do not
         trigger another resume (all slots of a dead host coalesce into one
-        reset, like the reference's per-reconfiguration counting)."""
-        self._host_manager.blacklist.blacklist(host)
+        reset, like the reference's per-reconfiguration counting).
+
+        Reshape casualties are NOT blacklisted: on this runtime a world
+        transition tears down the jax.distributed backend under live
+        collectives, so workers of the outgoing world routinely die
+        nonzero (shutdown-barrier aborts) through no fault of their host.
+        A worker whose spawn world is already superseded, or that dies
+        while a resume is pending/in flight, is such a casualty —
+        blacklisting it (permanently, without --blacklist-cooldown-range)
+        left single-host worlds unable to respawn after their own
+        scale-up."""
+        casualty = (0 <= version < self._driver.world_version) or \
+            self._driver.resume_in_flight
+        if not casualty:
+            self._host_manager.blacklist.blacklist(host)
         self._record(host, slot, FAILURE, version)
 
     def _record(self, host: str, slot: int, state: str,

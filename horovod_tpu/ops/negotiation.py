@@ -20,9 +20,15 @@ enters here — under jit, issue order is program order and XLA enforces it
 (the reference itself disables cycling for its XLA path,
 operations.cc:528-534).
 
-Cost model: two KV round-trips per *new* tensor signature; repeat
-submissions hit the native response cache and dispatch immediately, which is
-the same steady-state the reference reaches via its bitvector fast path.
+Cost model (round 4): a *new* tensor signature costs ONE KV round-trip on
+non-coordinator ranks (put_wait: announce the request and await the verdict
+server-side) and zero on the coordinator (its signature feeds the message
+table locally; the verdict is the return value).  A *cached* dispatch costs
+zero synchronous round-trips: its replay-stream record is buffered locally
+and shipped by the flusher thread in one batch-put per cycle — the same
+amortization the reference gets from folding all cache coherence into one
+bitvector collective per ~1 ms controller cycle (controller.cc:845
+CoordinateCacheAndState).
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -107,6 +114,23 @@ class Negotiator:
         self._ring = int(os.environ.get("HVD_TPU_DISPATCH_RING", "1024"))
         self._timeout = float(os.environ.get(
             _config.HOROVOD_GLOO_TIMEOUT_SECONDS, "300"))
+        # Per-cycle batched stream flush (the analog of the reference's
+        # once-per-cycle bitvector exchange, controller.cc:845): cached
+        # dispatches append records to a local buffer; a flusher thread
+        # ships the whole buffer in ONE batch-put per cycle.  A dispatch
+        # therefore costs no synchronous KV round-trip — record visibility
+        # for joined peers lags at most one cycle, and the device
+        # collective's asynchronous dispatch means the issuing rank never
+        # blocks inside that window (JAX queues the execution; the Python
+        # thread keeps running and the flusher keeps flushing).
+        self._flush_interval = float(os.environ.get(
+            "HVD_TPU_DISPATCH_FLUSH_MS", "3")) / 1e3
+        self._buf: list = []
+        self._buf_lock = threading.Lock()
+        self._flush_lock = threading.Lock()  # serializes batch shipping
+        self._flusher = None
+        self._flush_error: Optional[BaseException] = None
+        self._closed = False
 
     # -- protocol -------------------------------------------------------------
 
@@ -188,8 +212,6 @@ class Negotiator:
         self.publish_dispatch(name, epoch, sig, kind)
         if timeline is not None:
             timeline.negotiate_start(name, kind.upper())
-        self.client.put(req_scope, str(self.rank),
-                        json.dumps(sig).encode())
         try:
             if self.rank == 0:
                 if epoch > 0:
@@ -199,13 +221,17 @@ class Negotiator:
                         self.client.delete(scope, f"resp/{name}/{epoch - 1}")
                     except Exception:
                         pass
-                self._coordinate(name, epoch, sig, timeline, kind)
-            verdict = self._wait_response(name, resp_key)
-            # Own request record is consumed; drop it.
-            try:
-                self.client.delete(req_scope, str(self.rank))
-            except Exception:
-                pass
+                # The coordinator feeds its own signature to the message
+                # table locally and learns the verdict as the return value
+                # — no request PUT, no verdict GET.
+                verdict = self._coordinate(name, epoch, sig, timeline, kind)
+            else:
+                # ONE round-trip: announce the request and await the
+                # verdict server-side (put_wait).  At np=16 the request
+                # count IS the latency floor of a negotiation, so folding
+                # announce+await halves the worker cost.
+                verdict = self._submit_and_wait(req_scope, sig, name,
+                                                scope, resp_key)
         finally:
             if timeline is not None:
                 timeline.negotiate_end(name, kind.upper())
@@ -282,13 +308,91 @@ class Negotiator:
     def publish_dispatch(self, name: str, epoch: int, sig: dict,
                          kind: str) -> None:
         """Append one replayable record to this rank's dispatch stream
-        (ring-buffered in the KV store; slot reuse is the GC)."""
+        (ring-buffered in the KV store; slot reuse is the GC).
+
+        The append is LOCAL: records accumulate in a buffer that the
+        flusher thread ships once per cycle in a single batch-put — a
+        cached dispatch costs zero synchronous KV round-trips, matching
+        the reference's amortization of all cache-coherence traffic into
+        one bitvector exchange per cycle (controller.cc:845).  A buffer
+        occupancy of ring/4 forces an inline flush so slot reuse can never
+        outrun visibility."""
+        if self._flush_error is not None:
+            err, self._flush_error = self._flush_error, None
+            raise err
         self.dispatch_seq += 1
         rec = {"seq": self.dispatch_seq, "name": name, "epoch": epoch,
                "sig": sig, "kind": kind}
-        self.client.put(f"disp@{self._gen}",
-                        f"{self.rank}/{self.dispatch_seq % self._ring}",
-                        json.dumps(rec).encode())
+        with self._buf_lock:
+            self._buf.append((f"{self.rank}/{self.dispatch_seq % self._ring}",
+                              json.dumps(rec).encode()))
+            pending = len(self._buf)
+        if pending >= max(1, self._ring // 4):
+            self.flush_dispatches()
+        elif self._flusher is None:
+            self._start_flusher()
+
+    def flush_dispatches(self) -> None:
+        """Ship every buffered stream record in one batch-put.  The flush
+        lock serializes inline and flusher-thread flushes so batches land
+        in seq order (an out-of-order ship could regress a reused ring
+        slot to an older lap)."""
+        with self._flush_lock:
+            with self._buf_lock:
+                if not self._buf:
+                    return
+                batch, self._buf = self._buf, []
+            try:
+                self.client.put_batch(f"disp@{self._gen}", dict(batch))
+            except Exception:
+                # Re-queue: a transient KV failure must not punch a
+                # permanent hole in the replay stream (a joined peer
+                # polling the dropped seq would hang to the join timeout).
+                with self._buf_lock:
+                    self._buf[:0] = batch
+                raise
+
+    def _start_flusher(self) -> None:
+        with self._buf_lock:
+            if self._flusher is not None or self._closed:
+                return
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name=f"hvd-dispatch-flush-{self.rank}")
+            self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self._flush_interval)
+            try:
+                self.flush_dispatches()
+            except Exception as e:
+                # Surface on the dispatching thread: the next
+                # publish_dispatch rethrows (a dead KV during an elastic
+                # teardown window is routine; a healthy run maps it to
+                # HorovodInternalError there).
+                self._flush_error = e
+
+    def close(self) -> None:
+        """Stop the flusher and ship any pending records, BOUNDED: close
+        runs inside shutdown()/atexit, and an unreachable rendezvous would
+        otherwise block exit ~60 s in connect timeouts (slow worker death
+        is exactly what the elastic teardown paths fight).  The flush runs
+        in a daemon thread with a short join; abandoning records at
+        process exit is fine — nobody will replay a dead generation."""
+        self._closed = True
+        t = threading.Thread(target=lambda: self._swallow(
+            self.flush_dispatches), daemon=True,
+            name=f"hvd-dispatch-close-{self.rank}")
+        t.start()
+        t.join(2.0)
+
+    @staticmethod
+    def _swallow(fn) -> None:
+        try:
+            fn()
+        except Exception:
+            pass
 
     @_kv_guarded
     def poll_dispatch(self, src: int, seq: int) -> Optional[dict]:
@@ -342,10 +446,30 @@ class Negotiator:
             except Exception:
                 pass
 
+    def _submit_and_wait(self, req_scope: str, sig: dict, name: str,
+                         scope: str, resp_key: str) -> str:
+        """Non-coordinator rank: one put_wait round-trip announces the
+        request and returns the verdict.  On a wait-chunk timeout the
+        request is re-put (idempotent; the coordinator's arrived-set
+        dedups)."""
+        body = json.dumps(sig).encode()
+        deadline = time.time() + self._timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise HorovodInternalError(
+                    f"timed out waiting for negotiation verdict on {name!r}")
+            raw = self.client.put_wait(req_scope, str(self.rank), body,
+                                       scope, resp_key,
+                                       wait=min(remaining, 5.0))
+            if raw is not None:
+                return json.loads(raw).get("error", "")
+
     def _coordinate(self, name: str, epoch: int, my_sig: dict,
-                    timeline, kind: str = "allreduce") -> None:
+                    timeline, kind: str = "allreduce") -> str:
         """Rank 0: gather all ranks' requests, run the native message table,
-        publish the verdict (ComputeResponseList slow path).
+        publish the verdict (ComputeResponseList slow path) and return it
+        ("" = approved).
 
         The message table is keyed per (name, epoch) and unconditionally
         erased on every exit path — an error verdict (timeout, duplicate,
@@ -361,13 +485,32 @@ class Negotiator:
         arrived = set()
         last_stall_check = time.time()
         req_scope = self._req_scope(name, epoch)
+        first_ps_ranks = my_sig.get("ps_ranks")
         try:
+            # The coordinator's own signature enters the table directly —
+            # its request never touches the KV store.
+            res = self.msgtable.increment(
+                tbl_key, my_sig["dtype"], my_sig["shape"], my_sig["op"], 0,
+                my_sig["prescale"], my_sig["postscale"], my_sig["ps_id"])
+            if res == -1:
+                return self._publish(name, epoch,
+                                     "duplicate request from rank 0 "
+                                     "(DUPLICATE_NAME_ERROR)")
+            arrived.add(0)
+            self.stall.record_request(tbl_key, 0, time.time())
+            if timeline is not None:
+                timeline.negotiate_rank_ready(name, 0)
             while len(arrived) < self.size:
                 # ONE dedicated-scope scan per poll collects every rank's
-                # request (keys are plain rank numbers) — a per-rank GET
-                # loop is O(size) requests per 10 ms and starves the
-                # server at np >= 16.
-                scope = self.client.scan(req_scope)
+                # request (keys are plain rank numbers; rank 0's never
+                # hits the KV, hence size-1) — a per-rank GET loop is
+                # O(size) requests per 10 ms and starves the server at
+                # np >= 16.  The scan long-polls until all requests are
+                # present (or 1 s passes for a stall check), so the
+                # last-arriving rank wakes the coordinator immediately
+                # instead of landing in a 10 ms sleep quantum.
+                scope = self.client.scan(req_scope, wait=1.0,
+                                         min_keys=self.size - 1)
                 for key, raw in scope.items():
                     r = int(key)
                     if r in arrived:
@@ -377,23 +520,20 @@ class Negotiator:
                         tbl_key, sig["dtype"], sig["shape"], sig["op"], r,
                         sig["prescale"], sig["postscale"], sig["ps_id"])
                     if res == -1:
-                        self._publish(name, epoch,
-                                      f"duplicate request from rank {r} "
-                                      f"(DUPLICATE_NAME_ERROR)")
-                        return
+                        return self._publish(
+                            name, epoch,
+                            f"duplicate request from rank {r} "
+                            f"(DUPLICATE_NAME_ERROR)")
                     # Exact membership check: ps_id is a membership hash
                     # (ops._wire_ps), so the native table already rejects
                     # different memberships; this closes the residual
                     # hash-collision window with the rank lists themselves.
-                    if not arrived:
-                        first_ps_ranks = sig.get("ps_ranks")
-                    elif sig.get("ps_ranks") != first_ps_ranks:
-                        self._publish(
+                    if sig.get("ps_ranks") != first_ps_ranks:
+                        return self._publish(
                             name, epoch,
                             f"process-set membership mismatch on {name!r}: "
                             f"rank {r} announced {sig.get('ps_ranks')} vs "
                             f"{first_ps_ranks}")
-                        return
                     arrived.add(r)
                     self.stall.record_request(tbl_key, r, time.time())
                     if timeline is not None:
@@ -410,50 +550,43 @@ class Negotiator:
                                 "(HOROVOD_STALL_CHECK_TIME_SECONDS)",
                                 tname.split("#")[0], waited, ready, missing)
                     if st == 2:
-                        self._publish(name, epoch,
-                                      "stall shutdown threshold exceeded")
-                        return
+                        return self._publish(
+                            name, epoch,
+                            "stall shutdown threshold exceeded")
                 if now > deadline:
-                    self._publish(
+                    return self._publish(
                         name, epoch,
                         f"negotiation timed out; arrived={sorted(arrived)}")
-                    return
-                if len(arrived) < self.size:
-                    time.sleep(0.01)
+                # No sleep: the scan above long-polls server-side until
+                # every rank's request is present.
             if kind == "broadcast" and self.join_active():
                 root = my_sig["op"] - KIND_IDS["broadcast"]
                 if root in self.joined_ranks(
                         getattr(self, "join_round", 0)):
-                    self._publish(
+                    return self._publish(
                         name, epoch,
                         f"broadcast root rank {root} has joined "
                         f"(no data to broadcast)")
-                    return
             # Native validation errors embed the epoch-scoped table key;
             # surface the user-facing name instead.
-            self._publish(name, epoch,
-                          self.msgtable.validate(tbl_key).replace(tbl_key,
-                                                                  name))
+            return self._publish(
+                name, epoch,
+                self.msgtable.validate(tbl_key).replace(tbl_key, name))
         finally:
             self.stall.record_done(tbl_key)
             self.msgtable.erase(tbl_key)
+            # GC the request scope in ONE request, after the verdict is
+            # published (workers only re-put while the verdict is absent;
+            # a re-put racing this delete leaks at most one key of an
+            # epoch-scoped scope, never consumed again).
+            try:
+                self.client.delete_scope(req_scope)
+            except Exception:
+                pass
 
-    def _publish(self, name: str, epoch: int, err: str) -> None:
+    def _publish(self, name: str, epoch: int, err: str) -> str:
+        """Publish the verdict for the waiting ranks; return it for the
+        coordinator's own caller."""
         self.client.put(f"negotiate@{self._gen}", f"resp/{name}/{epoch}",
                         json.dumps({"error": err}).encode())
-
-    def _wait_response(self, name: str, resp_key: str) -> str:
-        """Long-polls the verdict: the KV server holds each GET until the
-        key exists, so a waiting rank costs the control plane ~1 request
-        per second instead of a 200 Hz polling loop (which saturated the
-        single server at np=16: cached-dispatch p50 64 ms from queueing)."""
-        deadline = time.time() + self._timeout
-        while True:
-            remaining = deadline - time.time()
-            if remaining <= 0:
-                raise HorovodInternalError(
-                    f"timed out waiting for negotiation verdict on {name!r}")
-            raw = self.client.get(f"negotiate@{self._gen}", resp_key,
-                                  wait=min(remaining, 5.0))
-            if raw is not None:
-                return json.loads(raw).get("error", "")
+        return err

@@ -50,14 +50,97 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def _cond(self, scope: str):
+        """Per-scope condition (all sharing the cache lock): a PUT wakes
+        only the waiters of ITS scope.  With one global condition every
+        request-PUT woke every verdict waiter in the world — at np=16 a
+        thundering herd of ~size^2 wakeups per negotiation."""
+        return self.server.scope_conds.setdefault(
+            scope, threading.Condition(self.server.cache_lock))
+
+    def _notify(self, scope: str) -> None:
+        c = self.server.scope_conds.get(scope)
+        if c is not None:
+            c.notify_all()
+
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
-        with self.server.cache_cond:
+        with self.server.cache_lock:
             scope_dict = self.server.cache.setdefault(self._scope(), {})
             scope_dict[self._key()] = value
-            self.server.cache_cond.notify_all()  # wake long-poll waiters
+            self._notify(self._scope())  # wake this scope's waiters
         self._empty(200)
+
+    def do_POST(self):
+        if self._key():
+            self._put_wait()
+            return
+        # Batch put: POST /{scope} with JSON {key: base64(value)} writes
+        # every pair under one lock acquisition and one wakeup.  This is
+        # the transport for the eager engine's per-cycle dispatch-stream
+        # flush (ops/negotiation.py): one request carries a whole cycle's
+        # records instead of one request per dispatch — the single
+        # highest-volume stream on the control plane.
+        import base64
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            items = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            self._empty(400)
+            return
+        with self.server.cache_lock:
+            scope_dict = self.server.cache.setdefault(self._scope(), {})
+            for k, v in items.items():
+                scope_dict[k] = base64.b64decode(v)
+            self._notify(self._scope())
+        self._empty(200)
+
+    def _put_wait(self):
+        # Put-then-await: POST /{scope}/{key}?ascope=S&akey=K&wait=s stores
+        # the body at scope/key, then holds the request until S/K exists
+        # and returns its value (404 on timeout).  This folds a worker's
+        # "announce my negotiation request, then long-poll the verdict"
+        # into ONE round-trip — at np=16 on a single server the request
+        # COUNT is the latency floor, so halving the per-rank requests
+        # halves new-signature negotiation time.
+        import time as _time
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(self.path).query)
+        try:
+            ascope = q["ascope"][0]
+            akey = q["akey"][0]
+        except (KeyError, IndexError):
+            self._empty(400)
+            return
+        try:
+            wait_s = min(float(q.get("wait", ["0"])[0]), 60.0)
+        except ValueError:
+            wait_s = 0.0
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        deadline = None
+        with self.server.cache_lock:
+            self.server.cache.setdefault(self._scope(), {})[self._key()] = \
+                value
+            self._notify(self._scope())
+            while True:
+                out = self.server.cache.get(ascope, {}).get(akey)
+                if out is not None:
+                    break
+                now = _time.monotonic()
+                if deadline is None:
+                    deadline = now + wait_s
+                if now >= deadline:
+                    break
+                self._cond(ascope).wait(deadline - now)
+        if out is None:
+            self._empty(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
 
     def do_GET(self):
         key = self._key()
@@ -79,7 +162,7 @@ class _KVHandler(BaseHTTPRequestHandler):
             except ValueError:
                 wait_s = 0.0
         deadline = None
-        with self.server.cache_cond:
+        with self.server.cache_lock:
             while True:
                 value = self.server.cache.get(self._scope(), {}).get(key)
                 if value is not None or wait_s <= 0:
@@ -90,7 +173,9 @@ class _KVHandler(BaseHTTPRequestHandler):
                     deadline = now + wait_s
                 if now >= deadline:
                     break
-                self.server.cache_cond.wait(deadline - now)
+                # Re-fetch each iteration: _gc_cond may have replaced the
+                # scope's condition while this waiter slept.
+                self._cond(self._scope()).wait(deadline - now)
         if value is None:
             self._empty(404)
             return
@@ -105,10 +190,38 @@ class _KVHandler(BaseHTTPRequestHandler):
         # would be O(keys) (e.g. the elastic init barrier reading
         # every rank's presence each poll, or the negotiation
         # coordinator collecting every rank's request).
+        #
+        # Long-poll variant: GET /{scope}?min=N&wait=s holds the request
+        # until the scope has >= N keys (or the wait elapses, returning
+        # whatever is there).  The negotiation coordinator uses it to
+        # collect all ranks' requests in ONE blocking request instead of a
+        # sleep-scan loop whose 10 ms quantum put a floor under every
+        # new-signature negotiation.
         import base64
         import json as _json
+        import time as _time
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(self.path).query)
+        min_keys, wait_s = 0, 0.0
+        try:
+            min_keys = int(q["min"][0]) if "min" in q else 0
+            wait_s = min(float(q["wait"][0]), 60.0) if "wait" in q else 0.0
+        except ValueError:
+            pass
+        deadline = None
         with self.server.cache_lock:
-            scope = dict(self.server.cache.get(self._scope(), {}))
+            while True:
+                scope = self.server.cache.get(self._scope(), {})
+                if min_keys <= 0 or len(scope) >= min_keys or wait_s <= 0:
+                    scope = dict(scope)
+                    break
+                now = _time.monotonic()
+                if deadline is None:
+                    deadline = now + wait_s
+                if now >= deadline:
+                    scope = dict(scope)
+                    break
+                self._cond(self._scope()).wait(deadline - now)
         body = _json.dumps({
             k: base64.b64encode(v).decode("ascii")
             for k, v in scope.items()}).encode()
@@ -119,15 +232,36 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         with self.server.cache_lock:
-            scope_dict = self.server.cache.get(self._scope())
-            if scope_dict is not None:
-                scope_dict.pop(self._key(), None)
-                if not scope_dict:
-                    # GC the emptied scope: per-(name, epoch) negotiation
-                    # scopes would otherwise leak one dict per negotiation
-                    # for the launcher's lifetime.
-                    self.server.cache.pop(self._scope(), None)
+            key = self._key()
+            if key == "":
+                # Scope delete: DELETE /{scope} drops the whole scope in
+                # one request (the negotiation coordinator GCs each
+                # per-(name, epoch) request scope this way instead of every
+                # rank deleting its own key).
+                self.server.cache.pop(self._scope(), None)
+                self._gc_cond(self._scope())
+            else:
+                scope_dict = self.server.cache.get(self._scope())
+                if scope_dict is not None:
+                    scope_dict.pop(key, None)
+                    if not scope_dict:
+                        # GC the emptied scope: per-(name, epoch)
+                        # negotiation scopes would otherwise leak one dict
+                        # per negotiation for the launcher's lifetime.
+                        self.server.cache.pop(self._scope(), None)
+                        self._gc_cond(self._scope())
         self._empty(200)
+
+    def _gc_cond(self, scope: str) -> None:
+        """Drop a deleted scope's condition (bounds memory to live scopes)
+        after waking its waiters — a waiter left on the popped condition
+        would otherwise sleep out its full timeout even if the key
+        reappeared (the reappearing PUT creates a NEW condition).  Woken
+        waiters re-check and, still-unsatisfied, time out their chunk and
+        re-issue, re-entering on the fresh condition."""
+        c = self.server.scope_conds.pop(scope, None)
+        if c is not None:
+            c.notify_all()
 
     def _path_parts(self):
         # Path segments are percent-encoded by KVStoreClient, so a literal
@@ -157,10 +291,11 @@ class KVStoreServer:
         self.httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self.httpd.cache = {}
         self.httpd.cache_lock = threading.Lock()
-        # Long-poll waiters sleep on this condition (same lock); every PUT
-        # notifies.  daemon_threads so a blocked long-poll never prevents
-        # interpreter exit.
-        self.httpd.cache_cond = threading.Condition(self.httpd.cache_lock)
+        # Long-poll waiters sleep on per-scope conditions (all sharing the
+        # cache lock); a PUT wakes only its scope's waiters.
+        # daemon_threads so a blocked long-poll never prevents interpreter
+        # exit.
+        self.httpd.scope_conds = {}
         self.httpd.daemon_threads = True
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="hvd-kvstore")
@@ -172,13 +307,20 @@ class KVStoreServer:
         return self.httpd.server_address[1]
 
     def put(self, scope: str, key: str, value: bytes):
-        with self.httpd.cache_cond:
+        with self.httpd.cache_lock:
             self.httpd.cache.setdefault(scope, {})[key] = value
-            self.httpd.cache_cond.notify_all()
+            c = self.httpd.scope_conds.get(scope)
+            if c is not None:
+                c.notify_all()
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         with self.httpd.cache_lock:
             return self.httpd.cache.get(scope, {}).get(key)
+
+    def scan_scope(self, scope: str) -> Dict[str, bytes]:
+        """Server-side scope snapshot (no HTTP round-trip)."""
+        with self.httpd.cache_lock:
+            return dict(self.httpd.cache.get(scope, {}))
 
     def stop(self):
         if self.httpd:
@@ -271,6 +413,18 @@ class KVStoreClient:
         if status >= 400:
             raise OSError(f"KV put {scope}/{key} failed: HTTP {status}")
 
+    def put_batch(self, scope: str, items: Dict[str, bytes]) -> None:
+        """Write many keys in ONE request (server applies them under one
+        lock, in iteration order).  The eager dispatch-stream flusher rides
+        this: a whole cycle's records cost one round-trip."""
+        import base64
+        body = json.dumps({
+            k: base64.b64encode(v).decode("ascii")
+            for k, v in items.items()}).encode()
+        status, _ = self._request("POST", self._path(scope), body=body)
+        if status >= 400:
+            raise OSError(f"KV put_batch {scope} failed: HTTP {status}")
+
     def get(self, scope: str, key: str,
             wait: float = 0.0) -> Optional[bytes]:
         """``wait`` > 0 long-polls: the server holds the request until the
@@ -288,15 +442,47 @@ class KVStoreClient:
             raise OSError(f"KV get {scope}/{key} failed: HTTP {status}")
         return data
 
+    def put_wait(self, scope: str, key: str, value: bytes,
+                 await_scope: str, await_key: str,
+                 wait: float) -> Optional[bytes]:
+        """Store ``value`` at scope/key, then block server-side until
+        ``await_scope``/``await_key`` exists and return its value (None on
+        timeout — re-issue; the re-put is idempotent).  One round-trip for
+        the announce-request-then-await-verdict pattern."""
+        from urllib.parse import quote
+        path = (self._path(scope, key)
+                + f"?ascope={quote(await_scope, safe='')}"
+                + f"&akey={quote(await_key, safe='')}"
+                + f"&wait={min(wait, 25.0):.3f}")
+        status, data = self._request("POST", path, body=value)
+        if status == 404:
+            return None
+        if status >= 400:
+            raise OSError(f"KV put_wait {scope}/{key} failed: HTTP {status}")
+        return data
+
     def delete(self, scope: str, key: str) -> None:
         status, _ = self._request("DELETE", self._path(scope, key))
         if status >= 400 and status != 404:
             raise OSError(f"KV delete {scope}/{key} failed: HTTP {status}")
 
-    def scan(self, scope: str) -> dict:
-        """Fetch a whole scope in ONE request: {key: value-bytes}."""
+    def delete_scope(self, scope: str) -> None:
+        """Drop a whole scope in one request."""
+        status, _ = self._request("DELETE", self._path(scope))
+        if status >= 400 and status != 404:
+            raise OSError(f"KV delete_scope {scope} failed: HTTP {status}")
+
+    def scan(self, scope: str, wait: float = 0.0,
+             min_keys: int = 0) -> dict:
+        """Fetch a whole scope in ONE request: {key: value-bytes}.
+        With ``min_keys`` > 0 and ``wait`` > 0, the server holds the
+        request until the scope has at least that many keys (or the wait
+        elapses — the caller re-checks and re-issues)."""
         import base64
-        status, data = self._request("GET", self._path(scope))
+        path = self._path(scope)
+        if min_keys > 0 and wait > 0:
+            path += f"?min={min_keys}&wait={min(wait, 25.0):.3f}"
+        status, data = self._request("GET", path)
         if status >= 400:
             raise OSError(f"KV scan {scope} failed: HTTP {status}")
         return {k: base64.b64decode(v)

@@ -70,22 +70,88 @@ def _columns_to_array(table_cols: dict, cols: Sequence[str]):
     return np.stack(arrs, axis=-1)
 
 
-def _read_shard(units, feature_cols, label_cols, filesystem=None):
-    """Read this rank's (file, row_group) units into (X, Y) numpy arrays."""
-    import numpy as np
-    import pyarrow.parquet as pq
-    frames = []
-    for f, g in units:
-        src = filesystem.open(f, "rb") if filesystem is not None else f
-        frames.append(pq.ParquetFile(src).read_row_group(g).to_pydict())
-    if not frames:
-        return None, None
-    import itertools
-    merged = {c: list(itertools.chain.from_iterable(fr[c] for fr in frames))
-              for c in frames[0]}
-    X = _columns_to_array(merged, feature_cols)
-    Y = _columns_to_array(merged, label_cols)
-    return np.asarray(X), np.asarray(Y)
+class RowGroupStream:
+    """Streams a rank's (file, row_group) units one group at a time —
+    the petastorm-reader contract the reference's estimator relies on
+    (spark/common/estimator.py:25: bigger-than-memory shards stream from
+    Parquet): peak memory is one row group plus a partial batch, never
+    the whole shard.  Epoch shuffling is two-level, the standard
+    streaming scheme: the row-group ORDER is re-permuted every epoch and
+    rows shuffle within each group; successive epochs see different
+    batch compositions without ever materializing the shard.
+
+    ``peak_rows_resident`` records the largest row count ever held, so
+    tests can assert the bounded-memory contract on shards much larger
+    than the budget."""
+
+    def __init__(self, units, feature_cols, label_cols, filesystem=None,
+                 seed: int = 0):
+        self.units = list(units)
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.filesystem = filesystem
+        self.seed = seed
+        self._files: dict = {}
+        self.peak_rows_resident = 0
+
+    def _pf(self, f):
+        if f not in self._files:
+            import pyarrow.parquet as pq
+            src = self.filesystem.open(f, "rb") \
+                if self.filesystem is not None else f
+            self._files[f] = pq.ParquetFile(src)
+        return self._files[f]
+
+    def num_rows(self) -> int:
+        """Total rows across the shard, from metadata only (no data read)."""
+        return sum(self._pf(f).metadata.row_group(g).num_rows
+                   for f, g in self.units)
+
+    def _read_group(self, f, g):
+        import numpy as np
+        d = self._pf(f).read_row_group(g).to_pydict()
+        X = _columns_to_array(d, self.feature_cols)
+        Y = _columns_to_array(d, self.label_cols)
+        return np.asarray(X), np.asarray(Y)
+
+    def iter_groups(self):
+        """(X, Y) per row group — validation evaluates group-wise."""
+        for f, g in self.units:
+            yield self._read_group(f, g)
+
+    def iter_batches(self, batch: int, epoch: int = 0,
+                     shuffle: bool = True):
+        """Exactly-``batch``-row arrays (static shapes for jit), streamed.
+        Yields floor(num_rows / batch) batches, or one wrap-filled batch
+        when the shard is smaller than a batch.  The sub-batch tail of
+        each group carries into the next group's batches."""
+        import numpy as np
+        rng = np.random.RandomState(self.seed * 100003 + epoch)
+        order = list(self.units)
+        if shuffle:
+            rng.shuffle(order)
+        carryX = carryY = None
+        yielded = 0
+        for f, g in order:
+            X, Y = self._read_group(f, g)
+            if shuffle:
+                p = rng.permutation(len(X))
+                X, Y = X[p], Y[p]
+            if carryX is not None and len(carryX):
+                X = np.concatenate([carryX, X])
+                Y = np.concatenate([carryY, Y])
+            self.peak_rows_resident = max(self.peak_rows_resident, len(X))
+            i = 0
+            while i + batch <= len(X):
+                yield X[i:i + batch], Y[i:i + batch]
+                yielded += 1
+                i += batch
+            carryX, carryY = X[i:], Y[i:]
+        if yielded == 0 and carryX is not None and len(carryX):
+            # Shard smaller than one batch: wrap-fill (static shapes).
+            reps = -(-batch // len(carryX))
+            yield (np.concatenate([carryX] * reps)[:batch],
+                   np.concatenate([carryY] * reps)[:batch])
 
 
 def _estimator_train_fn(cfg: dict) -> List[dict]:
@@ -110,24 +176,26 @@ def _estimator_train_fn(cfg: dict) -> List[dict]:
     fs = store.fs()
     units = shard_row_groups(store.get_parquet_files(cfg["train_path"]),
                              rank, size, filesystem=fs)
-    X, Y = _read_shard(units, cfg["feature_cols"], cfg["label_cols"],
-                       filesystem=fs)
-    if X is None:
+    stream = RowGroupStream(units, cfg["feature_cols"], cfg["label_cols"],
+                            filesystem=fs, seed=cfg["seed"] + rank)
+    total_rows = stream.num_rows()
+    if total_rows == 0:
         raise ValueError(
             f"rank {rank} received no parquet row groups; write the "
             f"training data with at least {size} row groups "
             f"(row_group_size small enough) or lower num_proc")
-    vX = vY = None
+    vstream = None
     if cfg.get("val_path"):
         vunits = shard_row_groups(
             store.get_parquet_files(cfg["val_path"]), rank, size,
             filesystem=fs)
-        vX, vY = _read_shard(vunits, cfg["feature_cols"], cfg["label_cols"],
-                             filesystem=fs)
+        vstream = RowGroupStream(vunits, cfg["feature_cols"],
+                                 cfg["label_cols"], filesystem=fs)
 
-    rng = np.random.RandomState(cfg["seed"] + rank)
+    X0, _ = next(stream.iter_batches(min(batch, total_rows), epoch=0,
+                                     shuffle=False))
     params = model.init(jax.random.PRNGKey(cfg["seed"]),
-                        jnp.asarray(X[:1]))
+                        jnp.asarray(X0[:1]))
     # Rank 0's initialization reaches everyone (BroadcastGlobalVariables
     # idiom) — model.init is deterministic here, but user models may not be.
     params = hvd.broadcast_parameters(params, root_rank=0)
@@ -147,36 +215,43 @@ def _estimator_train_fn(cfg: dict) -> List[dict]:
     # rank must dispatch the same number of optimizer updates per epoch
     # (the reference equalizes via steps_per_epoch / join; MIN-allreduce of
     # the local batch count is the static-shape-friendly form).
-    local_steps = max(len(X) // batch, 1)
+    local_steps = max(total_rows // batch, 1)
     nsteps = int(hvd.allreduce(jnp.asarray(float(local_steps)),
                                op=hvd.Min, name="est.steps"))
     history: List[dict] = []
     for epoch in range(cfg["epochs"]):
-        order = rng.permutation(len(X)) if cfg["shuffle"] else \
-            np.arange(len(X))
+        # Streamed batches, two-level shuffle per epoch (RowGroupStream):
+        # the shard never materializes — bigger-than-memory shards train
+        # at one-row-group peak memory (the petastorm contract).
+        batches = stream.iter_batches(batch, epoch=epoch,
+                                      shuffle=cfg["shuffle"])
         ep_loss = 0.0
-        for i in range(nsteps):
-            sel = order[(i * batch) % len(X):(i * batch) % len(X) + batch]
-            if len(sel) < batch:  # wrap for short tails: static shapes
-                sel = np.concatenate([sel, order[:batch - len(sel)]])
-            loss, grads = grad_step(params, jnp.asarray(X[sel]),
-                                    jnp.asarray(Y[sel]))
+        for _ in range(nsteps):
+            xb, yb = next(batches)
+            loss, grads = grad_step(params, jnp.asarray(xb),
+                                    jnp.asarray(yb))
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             ep_loss += float(loss)
-        entry = {"loss": float(hvd.allreduce(
+        entry = {"epoch": epoch, "loss": float(hvd.allreduce(
             jnp.asarray(ep_loss / nsteps), op=hvd.Average,
             name="est.loss"))}
         if cfg.get("val_path"):
             # EVERY rank dispatches this collective even if its shard got no
             # validation row groups (collectives are SPMD-total; a guarded
             # dispatch would deadlock).  Weighted sum handles the raggedness.
-            if vX is not None and len(vX):
-                vloss, w = float(eval_loss(params, jnp.asarray(vX),
-                                           jnp.asarray(vY))), 1.0
-            else:
-                vloss, w = 0.0, 0.0
-            agg = hvd.allreduce(jnp.asarray([vloss * w, w]), op=hvd.Sum,
+            # Validation streams group-wise too: the row-weighted sum over
+            # groups equals the full-shard loss for mean-reducing losses.
+            vloss_sum, vrows = 0.0, 0.0
+            if vstream is not None:
+                for vxb, vyb in vstream.iter_groups():
+                    if len(vxb) == 0:
+                        continue
+                    vloss_sum += float(eval_loss(
+                        params, jnp.asarray(vxb),
+                        jnp.asarray(vyb))) * len(vxb)
+                    vrows += len(vxb)
+            agg = hvd.allreduce(jnp.asarray([vloss_sum, vrows]), op=hvd.Sum,
                                 name="est.val_loss")
             if float(agg[1]) > 0:
                 entry["val_loss"] = float(agg[0]) / float(agg[1])

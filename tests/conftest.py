@@ -19,6 +19,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(items):
+    """xdist scheduling policy (--dist loadgroup, pyproject addopts).
+
+    Subprocess-world e2e tests (multi-process jax + gloo + rendezvous)
+    thrash each other when they overlap on this box's single host core —
+    cascading spurious stall timeouts and elastic resets.  Files that
+    spawn such worlds declare ``pytestmark = pytest.mark.xdist_group
+    ("heavy_e2e")`` so they all serialize on ONE xdist worker; every
+    unmarked test inherits its module as its group, preserving the
+    per-file serialization of plain --dist loadfile for the light
+    in-process tests."""
+    for item in items:
+        if not any(m.name == "xdist_group" for m in item.iter_markers()):
+            item.add_marker(
+                pytest.mark.xdist_group(item.module.__name__))
+
+
 @pytest.fixture()
 def hvd8():
     """Initialized runtime with 8 emulated ranks; torn down after the test."""

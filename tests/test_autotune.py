@@ -178,6 +178,7 @@ print(f"rank{{hvd.rank()}} BAYES converged={{pm.converged}} "
 
 
 @pytest.mark.integration
+@pytest.mark.xdist_group("heavy_e2e")
 def test_bayes_autotune_two_processes(tmp_path):
     """End-to-end: 2-process bayes autotune converges to ONE threshold on
     both ranks (rank-0 GP + published candidates + synced decision)."""

@@ -40,6 +40,7 @@ print(f"CKPT_OK rank={{hvd.rank()}}")
 
 
 @pytest.mark.integration
+@pytest.mark.xdist_group("heavy_e2e")
 def test_save_model_load_model_two_processes(tmp_path):
     """Real 2-process world (launcher + jax.distributed): rank-0-only
     orbax write must not deadlock against the release barrier (orbax's own

@@ -18,6 +18,33 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Serialize with the other subprocess-world e2e files (conftest
+# pytest_collection_modifyitems): overlapping multi-process worlds on one
+# host core cascade spurious stall timeouts.
+pytestmark = pytest.mark.xdist_group("heavy_e2e")
+
+import subprocess as _subprocess  # noqa: E402
+
+
+def run_world(cmd, *, timeout, env=None, tag="world"):
+    """subprocess.run wrapper that DUMPS the world's full output to /tmp
+    on a timeout — the assertion repr truncates it, which made wedged
+    elastic worlds undiagnosable."""
+    try:
+        return _subprocess.run(cmd, cwd=REPO, capture_output=True,
+                               text=True, timeout=timeout, env=env)
+    except _subprocess.TimeoutExpired as e:
+        dump = f"/tmp/hvd_world_timeout_{tag}_{os.getpid()}.log"
+        with open(dump, "w") as f:
+            for name, data in (("STDOUT", e.stdout), ("STDERR", e.stderr)):
+                f.write(f"==== {name} ====\n")
+                if data:
+                    f.write(data.decode("utf-8", "replace")
+                            if isinstance(data, bytes) else data)
+        e.args = (*e.args[:2], e.stdout, e.stderr)
+        raise _subprocess.TimeoutExpired(
+            e.cmd, e.timeout, output=f"full output dumped to {dump}")
+
 import horovod_tpu as hvd
 from horovod_tpu import elastic as E
 from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
@@ -448,6 +475,8 @@ def train(state):
         state.params = {{"w": state.params["w"] + 1.0}}
         state.batch += 1
         state.commit()
+        if state.batch == 2 and hvd.rank() == 0:
+            open({stepfile!r}, "w").close()  # signal: size-1 steps ran
         import time; time.sleep(0.8)
     return state.sizes
 
@@ -472,10 +501,18 @@ def test_elastic_scale_up_end_to_end(tmp_path):
     disc.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
     disc.chmod(0o755)
     worker = tmp_path / "worker.py"
-    worker.write_text(ELASTIC_SCALEUP_WORKER.format(repo=REPO))
+    stepfile = str(tmp_path / "first_steps_done")
+    worker.write_text(ELASTIC_SCALEUP_WORKER.format(repo=REPO,
+                                                    stepfile=stepfile))
 
     def scale_up():
-        time.sleep(8)
+        # Grow the world only after the size-1 world demonstrably trained
+        # (marker after 2 committed steps): a fixed sleep raced the
+        # worker's startup under full-suite load and the test then never
+        # observed a size-1 allreduce.
+        deadline = time.time() + 120
+        while not os.path.exists(stepfile) and time.time() < deadline:
+            time.sleep(0.25)
         hosts_file.write_text("localhost:2\n")
 
     t = threading.Thread(target=scale_up, daemon=True)
@@ -640,6 +677,12 @@ def train(state):
         bidx += 1
         state.sampler = sampler.state_dict()
         state.commit()
+        # Progress markers gate the test's reshape thread (a fixed sleep
+        # raced worker startup under load and the shrink went unobserved).
+        if hvd.rank() == 0 and state.sizes.count(3) >= 2:
+            open({m3!r}, "w").close()
+        if hvd.rank() == 0 and state.sizes.count(2) >= 2:
+            open({m2!r}, "w").close()
         time.sleep(0.45)
     return state.sizes
 
@@ -666,28 +709,36 @@ def test_elastic_scale_down_then_up_end_to_end(tmp_path):
     disc.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
     disc.chmod(0o755)
     worker = tmp_path / "worker.py"
-    worker.write_text(SCALE_DOWN_UP_WORKER.format(repo=REPO))
+    m3 = str(tmp_path / "trained_at_3")
+    m2 = str(tmp_path / "trained_at_2")
+    worker.write_text(SCALE_DOWN_UP_WORKER.format(repo=REPO, m3=m3, m2=m2))
 
     def reshape():
-        time.sleep(12)   # after the initial world is up and training
+        # Shrink only after the 3-world demonstrably trained, grow back
+        # only after the 2-world did (markers written by rank 0).
+        deadline = time.time() + 180
+        while not os.path.exists(m3) and time.time() < deadline:
+            time.sleep(0.25)
         hosts_file.write_text("localhost:2\n")
-        time.sleep(12)
+        while not os.path.exists(m2) and time.time() < deadline:
+            time.sleep(0.25)
         hosts_file.write_text("localhost:3\n")
 
     t = threading.Thread(target=reshape, daemon=True)
     t.start()
     env = dict(os.environ)
-    env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "20"  # fast stall recovery
+    env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "30"  # stall recovery with
+    # headroom against spurious full-suite-load stalls (see crash test)
     # Worker-side deadlines must sit WELL inside the subprocess budget:
     # under full-suite CPU load, gloo re-inits and negotiation rounds run
     # several times slower than in isolation (this test: 53 s alone).
     env["HOROVOD_ELASTIC_TIMEOUT"] = "150"
-    proc = subprocess.run(
+    proc = run_world(
         [sys.executable, "-m", "horovod_tpu.runner.launch",
          "--min-np", "2", "--max-np", "3",
          "--host-discovery-script", str(disc),
          sys.executable, str(worker)],
-        cwd=REPO, capture_output=True, text=True, timeout=480, env=env)
+        timeout=480, env=env, tag="scale_down")
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     import re as _re
     done = _re.findall(r"SDWORKER done rank=(\d) size=(\d) "
@@ -806,17 +857,26 @@ def test_abrupt_crash_resumes_from_spill(tmp_path):
     worker.write_text(CRASH_WORKER.format(repo=REPO, marker=marker))
     env = dict(os.environ)
     env["HVD_TPU_ELASTIC_SPILL_DIR"] = str(tmp_path / "spill")
-    env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "20"  # fast stall recovery
+    # 30 s: fast-but-not-hair-trigger stall recovery.  At 20 s, full-suite
+    # load made slow-but-alive negotiations look stalled, cascading
+    # spurious resets that could outlast even the 900 s budget.
+    env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "30"
     # A doomed survivor dies in the failed shutdown barrier; bound it so
     # the respawn cycle converges inside the test budget.
     env["HVD_TPU_DIST_SHUTDOWN_TIMEOUT_S"] = "10"
-    proc = subprocess.run(
+    proc = run_world(
         [sys.executable, "-m", "horovod_tpu.runner.launch",
          "--min-np", "2", "--max-np", "2",
          "--host-discovery-script", str(disc),
          "--blacklist-cooldown-range", "1", "3",
          sys.executable, str(worker)],
-        cwd=REPO, capture_output=True, text=True, timeout=420, env=env)
+        # 900 s: alone this finishes in ~35 s, but the full-suite runs
+        # share one host core with concurrently-running test files; the
+        # round-3 suite saw the old 420 s budget exceeded purely from
+        # load (the test then passed in isolation).  The generous budget
+        # costs nothing when healthy — the run exits as soon as it
+        # converges.
+        timeout=900, env=env, tag="abrupt_crash")
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-3000:]
     import re as _re
     done = _re.findall(

@@ -11,6 +11,11 @@ import horovod_tpu as hvd
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Serialize with the other subprocess-world e2e files (conftest
+# pytest_collection_modifyitems): overlapping multi-process worlds on one
+# host core cascade spurious stall timeouts.
+pytestmark = pytest.mark.xdist_group("heavy_e2e")
+
 WORKER_RANK1_JOINS_EARLY = """
 import jax
 jax.config.update('jax_platforms','cpu')

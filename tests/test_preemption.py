@@ -1,0 +1,205 @@
+"""TPU-VM preemption handling (SURVEY.md §5.3 "TPU equivalent"; reference
+contrast: horovod/runner/elastic/discovery.py:146 HostManager only learns
+of a host AFTER it fails).  The maintenance-notice path must drain the
+condemned host gracefully — commit, reshape, zero lost steps — where the
+crash path loses progress since the last commit."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from horovod_tpu import elastic as E
+from horovod_tpu.elastic.preemption import (PREEMPT_SCOPE,
+                                            PreemptionAwareDiscovery,
+                                            PreemptionSentinel)
+from horovod_tpu.runner.http_server import RendezvousServer
+
+
+class _FakeMetadataServer:
+    """Mock of the GCP metadata maintenance-event endpoint."""
+
+    def __init__(self):
+        self.event = "NONE"
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                assert self.headers.get("Metadata-Flavor") == "Google"
+                body = outer.event.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}/"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_sentinel_publishes_and_clears_marker():
+    meta = _FakeMetadataServer()
+    rdv = RendezvousServer()
+    port = rdv.start()
+    from horovod_tpu.runner.http_server import KVStoreClient
+    client = KVStoreClient("127.0.0.1", port)
+    try:
+        s = PreemptionSentinel(client, hostname="tpu-vm-3", url=meta.url,
+                               poll_interval_s=60)
+        s.step()
+        assert rdv.get(PREEMPT_SCOPE, "tpu-vm-3") is None  # NONE -> quiet
+        meta.event = "TERMINATE_ON_HOST_MAINTENANCE"
+        s.step()
+        assert rdv.get(PREEMPT_SCOPE, "tpu-vm-3") == \
+            b"TERMINATE_ON_HOST_MAINTENANCE"
+        meta.event = "NONE"  # cancelled: host rejoins the pool
+        s.step()
+        assert rdv.get(PREEMPT_SCOPE, "tpu-vm-3") is None
+    finally:
+        meta.stop()
+        rdv.stop()
+
+
+def test_sentinel_unreachable_endpoint_is_quiet():
+    rdv = RendezvousServer()
+    port = rdv.start()
+    from horovod_tpu.runner.http_server import KVStoreClient
+    client = KVStoreClient("127.0.0.1", port)
+    try:
+        s = PreemptionSentinel(client, hostname="h",
+                               url="http://127.0.0.1:1/none",
+                               poll_interval_s=60)
+        s.step()  # non-GCP host: no marker, no exception
+        assert rdv.get(PREEMPT_SCOPE, "h") is None
+    finally:
+        rdv.stop()
+
+
+def test_discovery_filters_marked_hosts():
+    inner = E.FixedHostDiscovery({"a": 2, "b": 2, "c": 1})
+    marked = set()
+    d = PreemptionAwareDiscovery(inner, lambda: marked)
+    assert d.find_available_hosts_and_slots() == {"a": 2, "b": 2, "c": 1}
+    marked.add("b")
+    assert d.find_available_hosts_and_slots() == {"a": 2, "c": 1}
+    marked.clear()
+    assert d.find_available_hosts_and_slots() == {"a": 2, "b": 2, "c": 1}
+
+
+class _LedgerWorkers:
+    """Thread workers that simulate a training loop with commits: each
+    iteration advances ``step``; a discovery-update bump (the real
+    HostsUpdatedInterrupt trigger) makes the worker COMMIT then exit;
+    a terminate_event (crash/immediate kill) exits WITHOUT committing —
+    the observable difference between graceful drain and host death."""
+
+    def __init__(self, rdv):
+        self.rdv = rdv
+        self.commits = {}   # host -> last committed step
+        self.steps = {}     # host -> last executed step
+        self.lock = threading.Lock()
+
+    def fn(self, slot, terminate_event, version):
+        host = slot.hostname
+        baseline_raw = self.rdv.get("discovery", "update")
+        baseline = json.loads(baseline_raw)["version"] if baseline_raw else 0
+        step = 0
+        while True:
+            step += 1
+            with self.lock:
+                self.steps[host] = step
+            time.sleep(0.02)
+            raw = self.rdv.get("discovery", "update")
+            if raw is not None and json.loads(raw)["version"] > baseline:
+                # the graceful path: interrupt observed at the next
+                # commit point -> state committed before exiting
+                with self.lock:
+                    self.commits[host] = step
+                return 0
+            if terminate_event.is_set():
+                return 1  # killed mid-step: nothing committed
+            if step >= 500:
+                return 0
+
+
+@pytest.mark.integration
+def test_preemption_drains_gracefully_crash_loses_progress():
+    """hB gets a maintenance notice -> its worker commits its CURRENT step
+    and the world reshapes without it (zero lost steps); contrast hC which
+    dies abruptly and loses everything since its last commit (here: all
+    progress)."""
+    rdv = RendezvousServer()
+    rdv.start()
+    inner = E.FixedHostDiscovery({"hA": 1, "hB": 1, "hC": 1})
+    driver = E.ElasticDriver(rdv, inner, 1, 3, cooldown_range=None,
+                             timeout=30)
+    workers = _LedgerWorkers(rdv)
+    try:
+        driver.start(workers.fn)
+        time.sleep(0.3)
+        v1 = driver.world_version
+
+        # --- graceful: preemption notice for hB (sentinel analog) ---
+        rdv.put(PREEMPT_SCOPE, "hB", b"TERMINATE_ON_HOST_MAINTENANCE")
+        deadline = time.time() + 10
+        while driver.world_version == v1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.world_version > v1, "no reshape after notice"
+        assert all(s.hostname != "hB"
+                   for s in driver.current_assignments())
+        # drain semantics: worker committed the step it was on
+        deadline = time.time() + 5
+        while "hB" not in workers.commits and time.time() < deadline:
+            time.sleep(0.05)
+        assert workers.commits.get("hB") == workers.steps["hB"], \
+            "graceful drain must commit the in-flight step"
+        # not a failure: no blacklist entry for hB
+        assert not driver.host_manager.blacklist.is_blacklisted("hB")
+    finally:
+        driver.stop()
+        rdv.stop()
+
+
+@pytest.mark.integration
+def test_crash_path_loses_progress_since_commit():
+    """The contrast case: a host that dies WITHOUT a maintenance notice
+    (abrupt kill) exits mid-step with nothing committed — the progress a
+    graceful drain preserves is exactly what the crash path loses."""
+    rdv = RendezvousServer()
+    rdv.start()
+    inner = E.FixedHostDiscovery({"hA": 1, "hC": 1})
+    driver = E.ElasticDriver(rdv, inner, 1, 2, cooldown_range=None,
+                             timeout=30)
+    workers = _LedgerWorkers(rdv)
+    try:
+        driver.start(workers.fn)
+        time.sleep(0.3)
+        with driver._lock:
+            crashed = driver._workers[("hC", 0)]
+        crashed.terminate_event.set()  # abrupt death: no notice, no drain
+        deadline = time.time() + 10
+        while driver.host_manager.blacklist.count("hC") == 0 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert "hC" not in workers.commits, \
+            "crash path must NOT have committed"
+        assert workers.steps.get("hC", 0) >= 1, \
+            "progress existed and was lost"
+        assert driver.host_manager.blacklist.count("hC") == 1
+    finally:
+        driver.stop()
+        rdv.stop()

@@ -18,6 +18,11 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Serialize with the other subprocess-world e2e files (conftest
+# pytest_collection_modifyitems): overlapping multi-process worlds on one
+# host core cascade spurious stall timeouts.
+pytestmark = pytest.mark.xdist_group("heavy_e2e")
+
 SCRIPT = r"""
 import json, os, sys
 N = int(os.environ["PSS_DEVICES"])

@@ -15,6 +15,11 @@ import pytest
 from horovod_tpu.spark.elastic import (SparkTaskPoolDiscovery,
                                        run_elastic, task_pool_loop)
 
+# Serialize with the other subprocess-world e2e files (conftest
+# pytest_collection_modifyitems): overlapping multi-process worlds on one
+# host core cascade spurious stall timeouts.
+pytestmark = pytest.mark.xdist_group("heavy_e2e")
+
 
 def thread_pool_factory(hostnames=None):
     """Task pool of threads on fake hostnames (default: all on one host)."""
