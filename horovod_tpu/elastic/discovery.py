@@ -116,6 +116,9 @@ class HostManager:
         self.discovery = discovery
         self.blacklist = Blacklist(cooldown_range)
         self.current_hosts: Dict[str, int] = {}
+        # Minimum slots the job needs (set by the ElasticDriver): the
+        # blacklist-starvation escape keys off this, not off zero hosts.
+        self.min_required = 1
         self._lock = threading.Lock()
 
     def update_available_hosts(self) -> int:
@@ -125,20 +128,26 @@ class HostManager:
         found_all = self.discovery.find_available_hosts_and_slots()
         found = {h: s for h, s in found_all.items()
                  if not self.blacklist.is_blacklisted(h)}
-        if not found and found_all:
-            # Pool starvation: every discoverable host is blacklisted.  A
-            # permanent blacklist (no --blacklist-cooldown-range) would
-            # guarantee job death on a single-host pool — e.g. a reshape's
-            # shutdown-barrier abort killing all of localhost's workers at
-            # once.  Readmit the least-recently-blacklisted host and let
-            # --reset-limit bound genuine crash loops.
-            h = min(found_all, key=self.blacklist.blacklisted_since)
-            get_logger().warning(
-                "all discoverable hosts blacklisted; readmitting %r "
-                "(pool-starvation escape; --reset-limit still bounds "
-                "crash loops)", h)
-            self.blacklist.forgive(h)
-            found[h] = found_all[h]
+        if sum(found.values()) < self.min_required and \
+                any(h not in found for h in found_all):
+            # Pool starvation: the blacklist has pushed discoverable
+            # capacity below what the job NEEDS (min_np).  A permanent
+            # blacklist (no --blacklist-cooldown-range) would guarantee
+            # job death — e.g. a reshape's shutdown-barrier abort killing
+            # all of localhost's workers at once, or one genuine crash in
+            # a pool with exactly min_np hosts.  Readmit least-recently-
+            # blacklisted hosts until capacity suffices; --reset-limit
+            # still bounds genuine crash loops.
+            for h in sorted((h for h in found_all if h not in found),
+                            key=self.blacklist.blacklisted_since):
+                get_logger().warning(
+                    "discoverable capacity below minimum with hosts "
+                    "blacklisted; readmitting %r (pool-starvation escape; "
+                    "--reset-limit still bounds crash loops)", h)
+                self.blacklist.forgive(h)
+                found[h] = found_all[h]
+                if sum(found.values()) >= self.min_required:
+                    break
         with self._lock:
             prev = self.current_hosts
             removed = [h for h in prev if h not in found]

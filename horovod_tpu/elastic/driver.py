@@ -51,6 +51,10 @@ class Worker:
         self.version = version  # refreshed on every world reactivation
         self.thread: Optional[threading.Thread] = None
         self.terminate_event = threading.Event()
+        # Set (under the driver lock) when a launch-scoped worker body
+        # confirmed no newer world adopted it and is about to return —
+        # adoption must replace, not keep, a retired record.
+        self.retired = False
         # Graceful decommission (scale-down): the slot fell out of the new
         # world, so the exit is not a failure and must not blacklist the
         # (still healthy) host.
@@ -83,6 +87,7 @@ class ElasticDriver:
         self._preempt_marked = _marked_hosts
         discovery = PreemptionAwareDiscovery(discovery, _marked_hosts)
         self.host_manager = HostManager(discovery, cooldown_range)
+        self.host_manager.min_required = min_np  # starvation-escape floor
         self.min_np = min_np
         self.max_np = max_np or min_np
         self.timeout = timeout
@@ -161,6 +166,31 @@ class ElasticDriver:
         the registry to classify worker deaths as reshape casualties)."""
         with self._lock:
             return self._resume_pending or self._resumes_inflight > 0
+
+    def retire_if_settled(self, hostname: str, local_rank: int,
+                          world_version: int):
+        """Launch-scoped worker bodies (the Spark task-pool protocol runs
+        ONE launch per world) call this before returning after a clean
+        launch.  ATOMICALLY with the adoption decision (_activate_world
+        runs under the same lock): if a newer world has adopted this
+        (host, local_rank), returns ``(False, new_slot, new_version)`` —
+        the caller must serve the new world; otherwise marks the worker
+        record retired (adoption will replace it, never keep it) and
+        returns ``(True, None, version)`` — safe to exit.  Without this
+        handshake a thread checking the version lock-free could decide to
+        exit just as adoption kept its still-alive record, leaving the
+        slot silently unserved."""
+        with self._lock:
+            if self._world_version != world_version:
+                mine = [s for s in self._assignments
+                        if (s.hostname, s.local_rank) ==
+                        (hostname, local_rank)]
+                if mine:
+                    return False, mine[0], self._world_version
+            w = self._workers.get((hostname, local_rank))
+            if w is not None:
+                w.retired = True
+            return True, None, self._world_version
 
     def current_assignments(self) -> List[_hosts.SlotInfo]:
         with self._lock:
@@ -281,13 +311,19 @@ class ElasticDriver:
             for slot in new_assignments:
                 key = (slot.hostname, slot.local_rank)
                 w = self._workers.get(key)
-                if w is not None and w.decommissioned and \
-                        (w.thread is None or not w.thread.is_alive() or
-                         w.terminate_event.is_set()):
-                    # Discovery flapped back but the decommissioned worker
-                    # is already gone (or past the point of no return):
-                    # replace it.  (Its deregister pops only its own
-                    # registration, so the overwrite is safe.)
+                if w is not None and (
+                        w.retired or
+                        w.thread is None or not w.thread.is_alive() or
+                        (w.decommissioned and w.terminate_event.is_set())):
+                    # A worker whose thread already finished cannot serve
+                    # the new world — launch-scoped worker bodies (the
+                    # Spark task-pool protocol runs ONE launch per world)
+                    # return when their launch completes, so adopting the
+                    # record would leave the slot silently unserved.  Same
+                    # for a decommissioned worker past the point of no
+                    # return.  Replace with a fresh launch; the old
+                    # thread's deregister pops only its own registration,
+                    # so the overwrite is safe.
                     w = None
                 if w is not None:
                     # Surviving worker adopted into the new world: clear

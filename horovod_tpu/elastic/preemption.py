@@ -101,16 +101,19 @@ class PreemptionSentinel:
             # incarnation of this host (its sentinel died with the drained
             # workers; only a live sentinel can clear the marker, so every
             # sentinel reconciles once at startup or the host could never
-            # rejoin the pool).
+            # rejoin the pool).  The reconcile counts only when the delete
+            # SUCCEEDS — a transient KV error here must retry next poll,
+            # not silently leave the host excluded forever.
             try:
                 self.client.delete(PREEMPT_SCOPE, self.host)
                 if self._marked:
                     get_logger().info("maintenance notice on %s cleared",
                                       self.host)
                 self._marked = False
+                self._startup_reconciled = True
             except Exception:
                 pass
-        if event is not None:
+        elif event is not None:
             self._startup_reconciled = True
 
     def start(self) -> None:

@@ -351,7 +351,26 @@ def run_elastic(fn: Callable,
         # registered forever — driver.join() would hang instead of the
         # failure being recorded and reshaped around.
         try:
-            return _worker_fn_inner(slot, terminate_event, world_version)
+            while True:
+                code = _worker_fn_inner(slot, terminate_event,
+                                        world_version)
+                if code != 0 or terminate_event.is_set():
+                    return code
+                # The launch completed cleanly, but this Worker thread may
+                # have been ADOPTED into a newer world meanwhile (the
+                # driver keeps live workers across reshapes).  Launches
+                # are WORLD-scoped in the task-pool protocol — serve the
+                # current world with a fresh launch when this slot is
+                # still assigned.  retire_if_settled decides atomically
+                # with the driver's adoption (same lock): either we serve
+                # the newer world, or the record is marked retired so a
+                # reshape racing our exit replaces it with a fresh launch
+                # instead of keeping an exiting thread.
+                settled, new_slot, cur = driver.retire_if_settled(
+                    slot.hostname, slot.local_rank, world_version)
+                if settled:
+                    return 0
+                slot, world_version = new_slot, cur
         except Exception:
             get_logger().warning(
                 "spark elastic: worker slot %s:%d failed in the launch "
