@@ -130,9 +130,70 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                          start_epoch=0, end_epoch=None)
 
 
+class EarlyStoppingCallback(Callback):
+    """Stop training when a monitored metric stops improving (the Keras
+    EarlyStopping the reference's estimators accept as a fit callback).
+
+    SPMD contract: the decision must be IDENTICAL on every rank — monitor
+    only metrics that are already rank-consistent (the estimator's
+    ``loss``/``val_loss`` are metric-averaged over ranks before callbacks
+    fire; hand-rolled loops should apply MetricAverageCallback first).
+    The driving loop checks ``stop_training`` after ``on_epoch_end``."""
+
+    def __init__(self, monitor: str = "val_loss", patience: int = 0,
+                 min_delta: float = 0.0, mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.mode = mode
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.stop_training = False
+        self.stopped_epoch: Optional[int] = None
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state=None):
+        if not logs or self.monitor not in logs:
+            # Keras parity: warn, don't silently disable — the default
+            # monitor 'val_loss' is absent when no validation is
+            # configured, and a typoed name would otherwise train every
+            # epoch with the user none the wiser.
+            if not getattr(self, "_warned_missing", False):
+                self._warned_missing = True
+                from .utils import get_logger
+                get_logger().warning(
+                    "EarlyStoppingCallback: monitored metric %r not in "
+                    "epoch logs (keys: %s) — early stopping inactive",
+                    self.monitor, sorted(logs or {}))
+            return
+        value = float(logs[self.monitor])
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            self.stop_training = True
+            self.stopped_epoch = epoch
+
+
 class CallbackList:
     def __init__(self, callbacks: List[Callback]):
         self.callbacks = list(callbacks)
+
+    @property
+    def stop_training(self) -> bool:
+        return any(getattr(cb, "stop_training", False)
+                   for cb in self.callbacks)
 
     def __getattr__(self, hook):
         if not hook.startswith("on_"):

@@ -168,7 +168,7 @@ class ElasticDriver:
             return self._resume_pending or self._resumes_inflight > 0
 
     def retire_if_settled(self, hostname: str, local_rank: int,
-                          world_version: int):
+                          world_version: int, terminate_event=None):
         """Launch-scoped worker bodies (the Spark task-pool protocol runs
         ONE launch per world) call this before returning after a clean
         launch.  ATOMICALLY with the adoption decision (_activate_world
@@ -179,16 +179,25 @@ class ElasticDriver:
         returns ``(True, None, version)`` — safe to exit.  Without this
         handshake a thread checking the version lock-free could decide to
         exit just as adoption kept its still-alive record, leaving the
-        slot silently unserved."""
+        slot silently unserved.
+
+        ``terminate_event`` identifies the CALLER's worker record (each
+        record owns a unique event): a thread whose record was already
+        replaced — or marked for termination — must settle, not serve,
+        or it would double-launch a slot its replacement already owns."""
         with self._lock:
-            if self._world_version != world_version:
+            w = self._workers.get((hostname, local_rank))
+            mine_record = w is not None and (
+                terminate_event is None or
+                w.terminate_event is terminate_event)
+            if self._world_version != world_version and mine_record and \
+                    not w.terminate_event.is_set():
                 mine = [s for s in self._assignments
                         if (s.hostname, s.local_rank) ==
                         (hostname, local_rank)]
                 if mine:
                     return False, mine[0], self._world_version
-            w = self._workers.get((hostname, local_rank))
-            if w is not None:
+            if mine_record:
                 w.retired = True
             return True, None, self._world_version
 

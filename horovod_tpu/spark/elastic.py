@@ -367,7 +367,8 @@ def run_elastic(fn: Callable,
                 # reshape racing our exit replaces it with a fresh launch
                 # instead of keeping an exiting thread.
                 settled, new_slot, cur = driver.retire_if_settled(
-                    slot.hostname, slot.local_rank, world_version)
+                    slot.hostname, slot.local_rank, world_version,
+                    terminate_event=terminate_event)
                 if settled:
                     return 0
                 slot, world_version = new_slot, cur

@@ -218,8 +218,12 @@ def _estimator_train_fn(cfg: dict) -> List[dict]:
     local_steps = max(total_rows // batch, 1)
     nsteps = int(hvd.allreduce(jnp.asarray(float(local_steps)),
                                op=hvd.Min, name="est.steps"))
+    from ..callbacks import CallbackList
+    cbs = CallbackList(cfg.get("callbacks") or [])
+    cbs.on_train_begin()
     history: List[dict] = []
     for epoch in range(cfg["epochs"]):
+        cbs.on_epoch_begin(epoch)
         # Streamed batches, two-level shuffle per epoch (RowGroupStream):
         # the shard never materializes — bigger-than-memory shards train
         # at one-row-group peak memory (the petastorm contract).
@@ -258,6 +262,17 @@ def _estimator_train_fn(cfg: dict) -> List[dict]:
         history.append(entry)
         if cfg["verbose"] and rank == 0:
             print(f"[estimator] epoch {epoch + 1}/{cfg['epochs']}: {entry}")
+        # Fit callbacks (the reference estimators accept Keras callbacks).
+        # The metrics in ``entry`` are allreduce-averaged, so callback
+        # decisions (e.g. EarlyStoppingCallback) are rank-consistent and
+        # every rank breaks out of the epoch loop together — an
+        # inconsistent break would strand peers in the next epoch's
+        # collectives.
+        cbs.on_epoch_end(epoch, logs=entry)
+        if cbs.stop_training:
+            if cfg["verbose"] and rank == 0:
+                print(f"[estimator] early stop after epoch {epoch + 1}")
+            break
     if rank == 0:
         store.write_obj(store.get_checkpoint_path(cfg["run_id"]), {
             "params": jax.device_get(params),
@@ -284,6 +299,12 @@ class HorovodTpuEstimator:
       validation: fraction in (0, 1) for a random split, or the name of a
         boolean column selecting validation rows (estimator.py semantics).
       num_proc: ranks to train with (Spark tasks or local processes).
+      callbacks: fit callbacks (horovod_tpu.callbacks.Callback objects,
+        cloudpickled to the workers): ``on_epoch_end(epoch, logs)`` fires
+        with the rank-averaged metrics entry, and a callback setting
+        ``stop_training`` (e.g. EarlyStoppingCallback) ends the fit on
+        every rank together — the Keras-callback surface the reference's
+        estimators accept.
       worker_platform: force a jax platform inside workers (tests use
         "cpu"; leave None on real TPU hosts).
     """
@@ -303,6 +324,7 @@ class HorovodTpuEstimator:
                  verbose: int = 1,
                  run_id: Optional[str] = None,
                  random_seed: int = 0,
+                 callbacks: Optional[list] = None,
                  worker_platform: Optional[str] = None):
         if model is None or optimizer is None or loss is None:
             raise ValueError("model, optimizer and loss are required")
@@ -323,6 +345,7 @@ class HorovodTpuEstimator:
         self.verbose = verbose
         self.run_id = run_id
         self.random_seed = random_seed
+        self.callbacks = list(callbacks or [])
         self.worker_platform = worker_platform
         self.history: List[dict] = []
 
@@ -406,6 +429,7 @@ class HorovodTpuEstimator:
             "label_cols": self.label_cols, "batch_size": self.batch_size,
             "epochs": self.epochs, "shuffle": self.shuffle,
             "verbose": self.verbose, "seed": self.random_seed,
+            "callbacks": self.callbacks,
             "store": store, "run_id": run_id,
             "train_path": train_path, "val_path": val_path,
             "platform": self.worker_platform,

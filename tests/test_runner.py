@@ -166,6 +166,81 @@ def test_kvstore_put_get_roundtrip():
         srv.stop()
 
 
+def test_kvstore_batch_put_and_scope_delete():
+    """Round-4 control-plane endpoints: one batch-put carries a whole
+    dispatch cycle; one scope DELETE GCs a negotiation request scope."""
+    srv = KVStoreServer()
+    port = srv.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        client.put_batch("b", {"k1": b"v1", "k2": b"\x00\xffbin",
+                               "sub/key": b"v3"})
+        assert client.get("b", "k1") == b"v1"
+        assert client.get("b", "k2") == b"\x00\xffbin"
+        assert client.get("b", "sub/key") == b"v3"
+        assert len(client.scan("b")) == 3
+        client.delete_scope("b")
+        assert client.scan("b") == {}
+        client.delete_scope("b")  # idempotent on a missing scope
+    finally:
+        srv.stop()
+
+
+def test_kvstore_put_wait_roundtrip():
+    """put_wait stores the request and holds the HTTP request until the
+    awaited key exists (the one-round-trip negotiation announce+await)."""
+    import threading
+    import time
+    srv = KVStoreServer()
+    port = srv.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        # Timeout path: awaited key never appears -> None, request stored.
+        out = client.put_wait("req", "0", b"sig", "resp_scope", "verdict",
+                              wait=0.3)
+        assert out is None
+        assert client.get("req", "0") == b"sig"
+
+        def publish():
+            time.sleep(0.3)
+            srv.put("resp_scope", "verdict", b"ok")
+
+        threading.Thread(target=publish, daemon=True).start()
+        t0 = time.time()
+        out = KVStoreClient("127.0.0.1", port).put_wait(
+            "req", "1", b"sig1", "resp_scope", "verdict", wait=10.0)
+        assert out == b"ok"
+        assert time.time() - t0 < 5.0  # woke on publish, not timeout
+    finally:
+        srv.stop()
+
+
+def test_kvstore_scan_min_keys_longpoll():
+    """Scan with min_keys holds until the scope reaches the count (the
+    coordinator's collect-all-requests primitive)."""
+    import threading
+    import time
+    srv = KVStoreServer()
+    port = srv.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        srv.put("rq", "0", b"a")
+
+        def add_more():
+            time.sleep(0.25)
+            srv.put("rq", "1", b"b")
+            srv.put("rq", "2", b"c")
+
+        threading.Thread(target=add_more, daemon=True).start()
+        out = client.scan("rq", wait=10.0, min_keys=3)
+        assert set(out) == {"0", "1", "2"}
+        # Timeout path returns whatever is there.
+        out = client.scan("rq", wait=0.2, min_keys=99)
+        assert len(out) == 3
+    finally:
+        srv.stop()
+
+
 def test_rendezvous_publishes_slots():
     srv = RendezvousServer()
     port = srv.start()

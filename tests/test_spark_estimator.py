@@ -165,6 +165,51 @@ def test_estimator_validation_column(tmp_path):
     assert model.run_id is not None
 
 
+def test_early_stopping_callback_unit():
+    from horovod_tpu.callbacks import EarlyStoppingCallback
+    cb = EarlyStoppingCallback(monitor="val_loss", patience=1,
+                               min_delta=0.1)
+    cb.on_epoch_end(0, {"val_loss": 1.0})
+    assert not cb.stop_training
+    cb.on_epoch_end(1, {"val_loss": 0.95})   # < min_delta improvement
+    assert not cb.stop_training               # wait=1 (== patience)
+    cb.on_epoch_end(2, {"val_loss": 0.94})
+    assert cb.stop_training and cb.stopped_epoch == 2
+    # improvement resets the counter
+    cb2 = EarlyStoppingCallback(monitor="loss", patience=0, mode="min")
+    cb2.on_epoch_end(0, {"loss": 1.0})
+    cb2.on_epoch_end(1, {"loss": 0.5})
+    assert not cb2.stop_training
+    cb2.on_epoch_end(2, {"loss": 0.6})
+    assert cb2.stop_training
+
+
+@pytest.mark.integration
+def test_estimator_early_stopping(tmp_path):
+    """Fit callbacks ride into the workers; EarlyStoppingCallback ends
+    the fit on every rank together (history shorter than epochs)."""
+    import optax
+    import pandas as pd
+    from horovod_tpu.callbacks import EarlyStoppingCallback
+    from horovod_tpu.models import create_mlp
+    from horovod_tpu.spark import HorovodTpuEstimator, LocalStore
+
+    est = HorovodTpuEstimator(
+        model=create_mlp((16, 4)), optimizer=optax.adam(1e-2),
+        loss="sparse_categorical_crossentropy",
+        feature_cols=["features"], label_cols=["y"],
+        batch_size=16, epochs=8,
+        # min_delta so large nothing ever counts as an improvement:
+        # deterministic stop after patience+1 epochs.
+        callbacks=[EarlyStoppingCallback(monitor="loss", patience=1,
+                                         min_delta=1e9)],
+        store=LocalStore(str(tmp_path / "st")), num_proc=2, verbose=0,
+        worker_platform="cpu")
+    model = est.fit(pd.DataFrame(_toy_frame()))
+    assert len(est.history) == 3  # epochs 0,1,2 then stop
+    assert model.history == est.history
+
+
 def test_row_group_stream_bounded_memory_and_epoch_shuffle(tmp_path):
     """The streaming-reader contract (petastorm analog,
     spark/common/estimator.py:25): a shard far larger than the per-group

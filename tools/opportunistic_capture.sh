@@ -18,9 +18,17 @@ probe() {
 }
 
 while true; do
-    if pgrep -f "pytest" >/dev/null 2>&1; then
-        # A test run owns the box's one core; a hung jax-import probe
-        # would steal CPU from subprocess-heavy e2e tests and flake them.
+    # A test run owns the box's one core; a hung jax-import probe would
+    # steal CPU from subprocess-heavy e2e tests and flake them.  Detect a
+    # real pytest invocation: a "pytest" token (bare or path-suffixed)
+    # within a command line's FIRST FIVE tokens covers `pytest ...`,
+    # `python -m pytest ...`, `/venv/bin/pytest`, and `timeout N python
+    # -m pytest ...`, while NOT matching processes that merely quote the
+    # word deep in an argument (a session wrapper's embedded prompt
+    # silenced this watcher entirely with a bare `pgrep -f pytest`).
+    if ps -eo args= | awk '{ for (i = 1; i <= 5 && i <= NF; i++)
+                                 if ($i ~ /(^|\/)pytest$/) f = 1 }
+                           END { exit !f }'; then
         sleep 60
         continue
     fi
