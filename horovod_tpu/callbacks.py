@@ -181,7 +181,9 @@ class EarlyStoppingCallback(Callback):
             self.wait = 0
             return
         self.wait += 1
-        if self.wait > self.patience:
+        # Keras semantics: stop once `patience` epochs pass with no
+        # improvement (wait >= patience; patience=0 stops on the first).
+        if self.wait >= max(self.patience, 1):
             self.stop_training = True
             self.stopped_epoch = epoch
 

@@ -234,16 +234,24 @@ grouped_allreduce_ = grouped_allreduce
 grouped_allreduce_async_ = grouped_allreduce_async
 
 
+# Jitted device-side pack: one fused concatenate instead of a device→host
+# copy per tensor (the reference engineered the same away with batched
+# D2D memcpy kernels, cuda_kernels.h:32-46).  jit's own cache keys on the
+# full argument signature (count + shapes + dtypes).
+_fusion_pack = jax.jit(lambda *ts: jnp.concatenate([t.ravel() for t in ts]))
+
+
 def _fused_allreduce(tensors: Sequence, op,
                      prescale_factor: float = 1.0,
                      postscale_factor: float = 1.0,
                      process_set: ProcessSet = global_process_set) -> List:
-    """Eager fused allreduce over one FLAT fusion buffer: host-side pack
-    (MemcpyInFusionBuffer, operations.cc:519), a single dispatched
-    collective for the whole bucket, then device-side slice+reshape
-    (MemcpyOutFusionBuffer).  One global-array assembly instead of one per
-    tensor — the reference's tensor-fusion data path, which is where the
-    eager dispatch time went (one device_put per leaf).
+    """Eager fused allreduce over one FLAT fusion buffer: device-side pack
+    (MemcpyInFusionBuffer, operations.cc:519 — here a jitted concatenate,
+    so gradients stay device-resident instead of round-tripping through
+    host numpy), a single dispatched collective for the whole bucket,
+    then device-side slice+reshape (MemcpyOutFusionBuffer).  One global-
+    array assembly instead of one per tensor — the reference's tensor-
+    fusion data path, which is where the eager dispatch time went.
 
     All tensors must share one dtype (the fusion planner only buckets
     same-dtype entries, csrc PlanFusion / controller.cc:901)."""
@@ -251,14 +259,12 @@ def _fused_allreduce(tensors: Sequence, op,
     axis = _axis()
     members = _members(process_set)
     eng = _engine()
-    np_ts = [np.asarray(t) for t in tensors]
-    dtype = np_ts[0].dtype
-    shapes = [t.shape for t in np_ts]
-    sizes = [int(t.size) for t in np_ts]
+    ts = [jnp.asarray(t) for t in tensors]
+    dtype = ts[0].dtype
+    shapes = [t.shape for t in ts]
+    sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
     offsets = np.concatenate([[0], np.cumsum(sizes)])
-    flat = np.empty(int(offsets[-1]), dtype=dtype)
-    for t, a, b in zip(np_ts, offsets[:-1], offsets[1:]):
-        flat[a:b] = t.ravel()
+    flat = _fusion_pack(*ts)
 
     def body(x):
         return C.allreduce(x, rop, axis_name=axis, members=members,
@@ -269,7 +275,7 @@ def _fused_allreduce(tensors: Sequence, op,
         x = C._apply_scale(ts[0], prescale_factor)
         return [C._apply_scale(x, postscale_factor)]
 
-    out = eng.run("allreduce", body, [jnp.asarray(flat)],
+    out = eng.run("allreduce", body, [flat],
                   (int(rop), members, prescale_factor, postscale_factor),
                   single, name=f"fusedbuf.{dtype}.{int(offsets[-1])}",
                   op_id=int(rop), prescale=prescale_factor,
@@ -344,25 +350,33 @@ def _allgatherv_parts(tensor, name):
     (ops/eager.py _replay_allgather_record) — change them together."""
     eng = _engine()
     n = eng.n
-    t = np.asarray(tensor)
-    size_vec = jnp.asarray(np.array([t.shape[0]], np.int64))
+    t = jnp.asarray(tensor)
+    rows = int(t.shape[0])
+    size_vec = jnp.asarray(np.array([rows], np.int64))
 
     def size_body(x):
         return C.allgather(x, axis_name=_axis())
 
+    # The size vector is the one legitimate host sync: the announced row
+    # counts determine SHAPES (the reference's recvcounts gather does the
+    # same).  The DATA stays device-resident: device-side pad, gather,
+    # and per-rank slices — no host round-trip of the payload.
     sizes = np.asarray(eng.run("allgather_sizes", size_body, [size_vec],
                                (), lambda ts: ts, name=None)[0]).ravel()
     max_rows = int(sizes.max())
-    padded = np.zeros((max_rows,) + t.shape[1:], dtype=t.dtype)
-    padded[:t.shape[0]] = t
+    if max_rows > rows:
+        pad = ((0, max_rows - rows),) + ((0, 0),) * (t.ndim - 1)
+        padded = jnp.pad(t, pad)
+    else:
+        padded = t
 
     def body(x):
         return lax.all_gather(x, _axis(), axis=0)  # [n, max, ...]
 
-    gathered = np.asarray(eng.run("allgather", body,
-                                  [jnp.asarray(padded)], (max_rows,),
-                                  lambda ts: [ts[0][None]], name=name)[0])
-    return [gathered[r, :sizes[r]] for r in range(n)], sizes
+    gathered = eng.run("allgather", body,
+                       [padded], (max_rows,),
+                       lambda ts: [ts[0][None]], name=name)[0]
+    return [gathered[r, :int(sizes[r])] for r in range(n)], sizes
 
 
 def _allgatherv_multiproc(tensor, members, name):
@@ -376,7 +390,7 @@ def _allgatherv_multiproc(tensor, members, name):
         return jnp.asarray(tensor)
     blocks, _ = _allgatherv_parts(tensor, name)
     sel = range(n) if members is None else members
-    return jnp.asarray(np.concatenate([blocks[r] for r in sel], axis=0))
+    return jnp.concatenate([blocks[r] for r in sel], axis=0)
 
 
 def allgather_async(tensor, name=None,
@@ -509,15 +523,18 @@ def _alltoallv_eager(tensor, splits, members):
     for src in range(n):
         if sp_sizes[src]:
             all_splits[src] = np.asarray(sp_blocks[src]).reshape(n)
-    t = np.asarray(tensor)
-    data_blocks, _ = _allgatherv_parts(jnp.asarray(t), None)
+    t = jnp.asarray(tensor)
+    data_blocks, _ = _allgatherv_parts(t, None)
     rank = _core.rank()
     offsets = np.concatenate(
         [np.zeros((n, 1), np.int64), np.cumsum(all_splits, axis=1)], axis=1)
-    parts = [np.asarray(data_blocks[src])[offsets[src, rank]:
-                                          offsets[src, rank + 1]]
+    # Device-side sub-block slices + one concatenate: the split table is
+    # host metadata (it determines shapes), the payload never leaves the
+    # device.
+    parts = [data_blocks[src][int(offsets[src, rank]):
+                              int(offsets[src, rank + 1])]
              for src in range(n)]
-    out = jnp.asarray(np.concatenate(parts, axis=0)) if parts else \
+    out = jnp.concatenate(parts, axis=0) if parts else \
         jnp.zeros((0,) + t.shape[1:], t.dtype)
     return out, jnp.asarray(all_splits[:, rank].copy())
 

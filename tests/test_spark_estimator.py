@@ -166,13 +166,15 @@ def test_estimator_validation_column(tmp_path):
 
 
 def test_early_stopping_callback_unit():
+    """Keras semantics: stop once `patience` epochs pass without
+    improvement (wait >= patience)."""
     from horovod_tpu.callbacks import EarlyStoppingCallback
-    cb = EarlyStoppingCallback(monitor="val_loss", patience=1,
+    cb = EarlyStoppingCallback(monitor="val_loss", patience=2,
                                min_delta=0.1)
     cb.on_epoch_end(0, {"val_loss": 1.0})
     assert not cb.stop_training
     cb.on_epoch_end(1, {"val_loss": 0.95})   # < min_delta improvement
-    assert not cb.stop_training               # wait=1 (== patience)
+    assert not cb.stop_training               # wait=1 < patience
     cb.on_epoch_end(2, {"val_loss": 0.94})
     assert cb.stop_training and cb.stopped_epoch == 2
     # improvement resets the counter
@@ -200,8 +202,8 @@ def test_estimator_early_stopping(tmp_path):
         feature_cols=["features"], label_cols=["y"],
         batch_size=16, epochs=8,
         # min_delta so large nothing ever counts as an improvement:
-        # deterministic stop after patience+1 epochs.
-        callbacks=[EarlyStoppingCallback(monitor="loss", patience=1,
+        # deterministic stop after `patience` non-improving epochs.
+        callbacks=[EarlyStoppingCallback(monitor="loss", patience=2,
                                          min_delta=1e9)],
         store=LocalStore(str(tmp_path / "st")), num_proc=2, verbose=0,
         worker_platform="cpu")

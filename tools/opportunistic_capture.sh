@@ -26,7 +26,7 @@ while true; do
     # -m pytest ...`, while NOT matching processes that merely quote the
     # word deep in an argument (a session wrapper's embedded prompt
     # silenced this watcher entirely with a bare `pgrep -f pytest`).
-    if ps -eo args= | awk '{ for (i = 1; i <= 5 && i <= NF; i++)
+    if ps -eo args= | awk '{ for (i = 1; i <= 10 && i <= NF; i++)
                                  if ($i ~ /(^|\/)pytest$/) f = 1 }
                            END { exit !f }'; then
         sleep 60
