@@ -234,11 +234,15 @@ grouped_allreduce_ = grouped_allreduce
 grouped_allreduce_async_ = grouped_allreduce_async
 
 
-# Jitted device-side pack: one fused concatenate instead of a device→host
-# copy per tensor (the reference engineered the same away with batched
-# D2D memcpy kernels, cuda_kernels.h:32-46).  jit's own cache keys on the
-# full argument signature (count + shapes + dtypes).
-_fusion_pack = jax.jit(lambda *ts: jnp.concatenate([t.ravel() for t in ts]))
+def _fusion_pack(*ts):
+    """Device-side pack: one concatenate instead of a device→host copy
+    per tensor (the reference engineered the same away with batched D2D
+    memcpy kernels, cuda_kernels.h:32-46).  Deliberately EAGER, not
+    jitted: autotune shifts fusion thresholds across scoring windows, so
+    bucket compositions change and a jitted pack would recompile on the
+    very steps autotune is timing; eager dispatch is a handful of cheap
+    reshape views plus one concatenate op."""
+    return jnp.concatenate([t.ravel() for t in ts])
 
 
 def _fused_allreduce(tensors: Sequence, op,
@@ -520,9 +524,14 @@ def _alltoallv_eager(tensor, splits, members):
     sp_blocks, sp_sizes = _allgatherv_parts(jnp.asarray(sp_local)[None, :],
                                             None)
     all_splits = np.zeros((n, n), np.int64)
-    for src in range(n):
-        if sp_sizes[src]:
-            all_splits[src] = np.asarray(sp_blocks[src]).reshape(n)
+    # ONE device→host sync for the whole split table (it is pure shape
+    # metadata): per-block np.asarray would cost n tiny blocking copies.
+    present = [src for src in range(n) if sp_sizes[src]]
+    if present:
+        flat_sp = np.asarray(jnp.concatenate(
+            [sp_blocks[src].reshape(-1) for src in present]))
+        for i, src in enumerate(present):
+            all_splits[src] = flat_sp[i * n:(i + 1) * n]
     t = jnp.asarray(tensor)
     data_blocks, _ = _allgatherv_parts(t, None)
     rank = _core.rank()

@@ -469,7 +469,8 @@ def run_elastic(fn: Callable,
         if missing:
             raise RuntimeError(
                 f"spark elastic finished but ranks {missing} reported no "
-                f"result for final world {final}")
+                f"result for final world {final} "
+                f"(result keys present: {sorted(raw_results)})")
         return [results[r] for r in sorted(expected)]
     finally:
         client.put(_SCOPE_CTL, "shutdown", b"1")

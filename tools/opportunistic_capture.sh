@@ -21,11 +21,14 @@ while true; do
     # A test run owns the box's one core; a hung jax-import probe would
     # steal CPU from subprocess-heavy e2e tests and flake them.  Detect a
     # real pytest invocation: a "pytest" token (bare or path-suffixed)
-    # within a command line's FIRST FIVE tokens covers `pytest ...`,
-    # `python -m pytest ...`, `/venv/bin/pytest`, and `timeout N python
-    # -m pytest ...`, while NOT matching processes that merely quote the
-    # word deep in an argument (a session wrapper's embedded prompt
+    # within a command line's FIRST TEN tokens covers `pytest ...`,
+    # `python -m pytest ...`, `/venv/bin/pytest`, and wrapper-prefixed
+    # forms (`timeout N`, `nice -n 10`, `env A=B`), while NOT matching
+    # processes that merely quote the word DEEP in an argument (a session
+    # wrapper's embedded prompt — "pytest" hundreds of tokens in —
     # silenced this watcher entirely with a bare `pgrep -f pytest`).
+    # Tradeoff: a wrapper quoting "pytest" within its first ten tokens
+    # would pause probing; none such runs here.
     if ps -eo args= | awk '{ for (i = 1; i <= 10 && i <= NF; i++)
                                  if ($i ~ /(^|\/)pytest$/) f = 1 }
                            END { exit !f }'; then
