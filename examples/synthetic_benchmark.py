@@ -54,7 +54,7 @@ def main(argv=None):
         from horovod_tpu.models import ResNet50, ResNet101
         cls = ResNet50 if args.model == "resnet50" else ResNet101
         model = cls(num_classes=1000, dtype=jnp.bfloat16,
-                    sync_bn=not args.no_sync_bn)
+                    axis_name=None if args.no_sync_bn else "hvd")
         images = jnp.asarray(
             np.random.RandomState(0).rand(batch, 224, 224, 3)
             .astype(np.float32))

@@ -250,9 +250,10 @@ def _fused_allreduce(tensors: Sequence, op,
                      postscale_factor: float = 1.0,
                      process_set: ProcessSet = global_process_set) -> List:
     """Eager fused allreduce over one FLAT fusion buffer: device-side pack
-    (MemcpyInFusionBuffer, operations.cc:519 — here a jitted concatenate,
-    so gradients stay device-resident instead of round-tripping through
-    host numpy), a single dispatched collective for the whole bucket,
+    (MemcpyInFusionBuffer, operations.cc:519 — here an eager device-side
+    concatenate, see _fusion_pack, so gradients stay device-resident
+    instead of round-tripping through host numpy), a single dispatched
+    collective for the whole bucket,
     then device-side slice+reshape (MemcpyOutFusionBuffer).  One global-
     array assembly instead of one per tensor — the reference's tensor-
     fusion data path, which is where the eager dispatch time went.
@@ -530,6 +531,15 @@ def _alltoallv_eager(tensor, splits, members):
     if present:
         flat_sp = np.asarray(jnp.concatenate(
             [sp_blocks[src].reshape(-1) for src in present]))
+        if flat_sp.size != len(present) * n:
+            # A malformed announcement (e.g. the emulated-mode [N, N]
+            # splits form passed in multi-process mode) must fail loudly:
+            # fixed-stride chunking over a wrong-length vector would
+            # silently shift every later rank's row.
+            raise ValueError(
+                f"alltoall splits exchange returned {flat_sp.size} values "
+                f"for {len(present)} ranks (expected {n} per rank); some "
+                f"rank announced a malformed splits vector")
         for i, src in enumerate(present):
             all_splits[src] = flat_sp[i * n:(i + 1) * n]
     t = jnp.asarray(tensor)
