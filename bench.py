@@ -146,14 +146,17 @@ def _wait_for_devices():
 
     Round-1 capture died rc=124 (one in-process attempt hung until the
     driver's timeout); round-2 died rc=1 (5 probes over ~12 min, then gave
-    up — the relay came back later).  So: ride out the outage for (nearly)
-    the driver's whole window.  Probes are short and killable; the loop
-    keeps trying until BENCH_PROBE_BUDGET_S elapses, then exits with a
-    clear one-line message rather than letting the driver's timeout
-    produce an opaque rc=124.  The warm .jax_cache/ keeps the post-probe
-    bench itself cheap (~40 s), so probing can safely use most of the
-    window."""
-    budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "2700"))
+    up — the relay came back later); round-3 probed for the FULL driver
+    window (2700 s) and the driver's timeout fired before the bench could
+    even emit its failure line.  So: ride out most — NOT all — of the
+    window, then fall back.  Probes are short and killable; the loop
+    tries until BENCH_PROBE_BUDGET_S elapses, then emits the last good
+    persisted capture labeled stale (or a clear one-line failure) while
+    driver time remains.  The warm .jax_cache/ keeps a post-probe bench
+    cheap, so a late probe success still produces a fresh capture."""
+    # 33 min of a ~45 min window: leaves time for the stale-capture
+    # emission (instant) or a real bench after a late probe success.
+    budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "1980"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "60"))
     start = time.monotonic()
     deadline = start + budget_s
