@@ -38,15 +38,21 @@ while true; do
     if probe; then
         echo "--- relay up $(date -u +%FT%TZ); running battery ---" >> "$LOG"
         # 1. ResNet-50 fast stem (the driver's default invocation).
-        # stdout goes to its own file: bench.py's stale-fallback ALSO
-        # exits 0 (driver contract), so rc alone can't distinguish a
-        # fresh capture from a stale emission — check the JSON too.
+        # bench.py emits the last-good record (stale:true) up front on
+        # EVERY run, then prints a fresh line on success — so success is
+        # rc==0 AND a non-stale LAST JSON line (the stale-only path exits
+        # nonzero since the round-5 emit-first rework, but belt+braces).
         OUT=artifacts/capture_resnet_fast.out
         timeout 1200 env BENCH_PROBE_BUDGET_S=120 python bench.py \
             > "$OUT" 2>> "$LOG"
         rc1=$?
         cat "$OUT" >> "$LOG"
-        if [ "$rc1" -eq 0 ] && grep -q '"stale": true' "$OUT"; then
+        if [ "$rc1" -eq 0 ] && ! python - "$OUT" <<'EOF'
+import json, sys
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+sys.exit(0 if lines and not json.loads(lines[-1]).get("stale") else 1)
+EOF
+        then
             rc1=99   # stale emission, not a fresh capture: keep looping
         fi
         # 2. ResNet-50 naive stem (for the s2d ablation in PERF_r04.md)
