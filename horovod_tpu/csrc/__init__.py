@@ -21,18 +21,42 @@ _lib = None
 _lock = threading.Lock()
 
 
+# Expected native ABI (hvd_core.cc hvd_core_abi_version): symbol additions
+# bump this number.  The library is LOADED through an ABI-tagged filename
+# (libhvdcore.abi<N>.so): dlopen caches by pathname, so a process that
+# loaded a stale build could never swap it for a rebuilt one under the same
+# name — the tagged name guarantees the first (and only) load in a process
+# is a build of the expected ABI.  A prebuilt base .so from an older tree
+# just means one `make clean` rebuild on first use of the new tree.
+_ABI = 2
+_SO_TAGGED = os.path.join(_DIR, f"libhvdcore.abi{_ABI}.so")
+
+
 def _build() -> None:
-    """Build under an exclusive file lock: N freshly-launched workers race
-    on first import; exactly one runs make (which itself writes via temp +
-    rename), the rest wait and load the finished library."""
+    """Produce the ABI-tagged library under an exclusive file lock: N
+    freshly-launched workers race on first import; exactly one runs make
+    (which itself writes via temp + rename), the rest wait and load the
+    finished library."""
     import fcntl
+    import glob
+    import shutil
     lock_path = os.path.join(_DIR, ".build.lock")
     with open(lock_path, "w") as lock_fh:
         fcntl.flock(lock_fh, fcntl.LOCK_EX)
         try:
-            if not os.path.exists(_SO):
-                subprocess.run(["make", "-s", "-C", _DIR], check=True,
-                               capture_output=True)
+            if os.path.exists(_SO_TAGGED):
+                return  # another worker finished while we waited
+            # The base .so may exist from an older tree (make only fires
+            # on a missing target): always rebuild it for a new tag.
+            subprocess.run(["make", "-s", "-C", _DIR, "clean"],
+                           check=True, capture_output=True)
+            subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                           capture_output=True)
+            for stale in glob.glob(os.path.join(_DIR, "libhvdcore.abi*.so")):
+                os.remove(stale)
+            tmp = _SO_TAGGED + ".tmp"
+            shutil.copy2(_SO, tmp)
+            os.replace(tmp, _SO_TAGGED)
         finally:
             fcntl.flock(lock_fh, fcntl.LOCK_UN)
 
@@ -45,11 +69,16 @@ def lib() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
+        if not os.path.exists(_SO_TAGGED):
             _build()
-        l = ctypes.CDLL(_SO)
-        # Signatures.
+        l = ctypes.CDLL(_SO_TAGGED)
         l.hvd_core_abi_version.restype = ctypes.c_int
+        if l.hvd_core_abi_version() != _ABI:
+            raise RuntimeError(
+                f"{_SO_TAGGED} reports ABI {l.hvd_core_abi_version()}, "
+                f"expected {_ABI}; delete horovod_tpu/csrc/libhvdcore*.so "
+                f"and re-import to rebuild")
+        # Signatures.
         sig_args = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                     ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
                     ctypes.c_int, ctypes.c_double, ctypes.c_double,
@@ -109,6 +138,24 @@ def lib() -> ctypes.CDLL:
         l.hvd_stall_check.restype = ctypes.c_int
         l.hvd_stall_check.argtypes = [ctypes.c_void_p, ctypes.c_double,
                                       ctypes.POINTER(ctypes.c_char_p)]
+
+        l.hvd_kv_start.restype = ctypes.c_void_p
+        l.hvd_kv_start.argtypes = [ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int)]
+        l.hvd_kv_stop.argtypes = [ctypes.c_void_p]
+        l.hvd_kv_destroy.argtypes = [ctypes.c_void_p]
+        l.hvd_kv_port.restype = ctypes.c_int
+        l.hvd_kv_port.argtypes = [ctypes.c_void_p]
+        l.hvd_kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+        l.hvd_kv_get.restype = ctypes.POINTER(ctypes.c_uint8)
+        l.hvd_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int64)]
+        l.hvd_kv_scan_json.restype = ctypes.c_void_p
+        l.hvd_kv_scan_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.hvd_kv_free.argtypes = [ctypes.c_void_p]
         _lib = l
         return _lib
 
@@ -288,5 +335,67 @@ class NativeStallInspector:
     def __del__(self):
         try:
             self._l.hvd_stall_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeKVServer:
+    """C++ HTTP KV/rendezvous server (csrc/kv_server.cc) — same wire
+    protocol as the Python ``_KVHandler``; per-request host CPU is ~10x
+    cheaper, which is the control-plane latency floor at np >= 16 on a
+    one-core launcher host.  The store stays readable (get/scan) after
+    ``stop()`` until the object dies — launcher code gathers results after
+    shutdown (runner/__init__.py)."""
+
+    def __init__(self):
+        self._l = lib()
+        self._h = None
+        self.port = None
+
+    def start(self, port: int = 0) -> int:
+        actual = ctypes.c_int(0)
+        h = self._l.hvd_kv_start(port, ctypes.byref(actual))
+        if not h:
+            raise OSError(f"native KV server failed to bind port {port}")
+        self._h = h
+        self.port = actual.value
+        return self.port
+
+    def stop(self) -> None:
+        if self._h is not None:
+            self._l.hvd_kv_stop(self._h)
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        self._l.hvd_kv_put(self._h, scope.encode(), key.encode(), value,
+                           len(value))
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        n = ctypes.c_int64(-1)
+        p = self._l.hvd_kv_get(self._h, scope.encode(), key.encode(),
+                               ctypes.byref(n))
+        if not p:
+            return None
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            self._l.hvd_kv_free(p)
+
+    def scan_scope(self, scope: str) -> dict:
+        import base64
+        import json
+        p = self._l.hvd_kv_scan_json(self._h, scope.encode())
+        if not p:
+            return {}
+        try:
+            raw = ctypes.string_at(p)
+        finally:
+            self._l.hvd_kv_free(p)
+        return {k: base64.b64decode(v)
+                for k, v in json.loads(raw.decode()).items()}
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._l.hvd_kv_destroy(self._h)
         except Exception:
             pass

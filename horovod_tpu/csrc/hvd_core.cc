@@ -453,7 +453,7 @@ static thread_local std::string g_strbuf;
 extern "C" {
 
 // --- version ---------------------------------------------------------------
-int hvd_core_abi_version() { return 1; }
+int hvd_core_abi_version() { return 2; }
 
 // --- ResponseCache ----------------------------------------------------------
 void* hvd_cache_create(int64_t capacity) {

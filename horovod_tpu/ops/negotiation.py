@@ -130,6 +130,12 @@ class Negotiator:
         self._flush_lock = threading.Lock()  # serializes batch shipping
         self._flusher = None
         self._flush_error: Optional[BaseException] = None
+        self._flush_error_logged = False
+        # Pending-records signal: the flusher sleeps on this instead of a
+        # fixed-interval poll — an idle rank costs ~1 wakeup/s, not 333/s
+        # (np idle flushers at a 3 ms cadence were real scheduling pressure
+        # on a one-core launcher host).
+        self._buf_event = threading.Event()
         self._closed = False
 
     # -- protocol -------------------------------------------------------------
@@ -329,8 +335,10 @@ class Negotiator:
             pending = len(self._buf)
         if pending >= max(1, self._ring // 4):
             self.flush_dispatches()
-        elif self._flusher is None:
-            self._start_flusher()
+        else:
+            self._buf_event.set()
+            if self._flusher is None:
+                self._start_flusher()
 
     def flush_dispatches(self) -> None:
         """Ship every buffered stream record in one batch-put.  The flush
@@ -363,15 +371,35 @@ class Negotiator:
 
     def _flush_loop(self) -> None:
         while not self._closed:
+            if not self._buf_event.wait(timeout=1.0):
+                continue  # nothing pending: stay parked
+            # Batch window: let the cycle's records accumulate, then ship
+            # them all in one batch-put.
             time.sleep(self._flush_interval)
+            self._buf_event.clear()
             try:
                 self.flush_dispatches()
+                self._flush_error_logged = False
             except Exception as e:
                 # Surface on the dispatching thread: the next
                 # publish_dispatch rethrows (a dead KV during an elastic
                 # teardown window is routine; a healthy run maps it to
-                # HorovodInternalError there).
+                # HorovodInternalError there).  ALSO log the first failure
+                # of a streak: a rank done dispatching never publishes
+                # again, and close() swallows — without this line a
+                # persistent KV failure would be invisible while a joined
+                # peer replaying this rank's stream times out.
                 self._flush_error = e
+                # Re-arm: the failed batch was re-queued into _buf, and a
+                # rank done dispatching would otherwise never retry it
+                # (the event was cleared above) — park-until-publish must
+                # not strand re-queued records.
+                self._buf_event.set()
+                if not self._flush_error_logged:
+                    self._flush_error_logged = True
+                    get_logger().warning(
+                        "dispatch-stream flush failed (records re-queued; "
+                        "rethrown on next publish): %r", e)
 
     def close(self) -> None:
         """Stop the flusher and ship any pending records, BOUNDED: close

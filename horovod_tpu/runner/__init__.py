@@ -117,15 +117,17 @@ if not sent:
     captured = {}
 
     def _capture(rendezvous):
-        # The dict object outlives the server shutdown.
-        captured["kv"] = rendezvous.httpd.cache
+        # The store outlives the server shutdown (KVStoreServer keeps it
+        # readable post-stop, whichever backend serves it).
+        captured["server"] = rendezvous
 
     try:
         ret = _run_static(parsed, on_rendezvous=_capture)
         if ret != 0:
             raise RuntimeError(
                 f"horovod_tpu.run failed with exit code {ret}")
-        kv_results = captured.get("kv", {}).get("runresults", {})
+        srv = captured.get("server")
+        kv_results = srv.scan_scope("runresults") if srv is not None else {}
         results = []
         for rank in range(np):
             raw = kv_results.get(str(rank))

@@ -15,6 +15,7 @@ records that change across resets).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -281,16 +282,39 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 
 class KVStoreServer:
-    """Threaded KV server (RendezvousServer base, http_server.py:192)."""
+    """KV server (RendezvousServer base, http_server.py:192).
+
+    Two interchangeable backends behind one API: the C++ server
+    (csrc/kv_server.cc, default — per-request host CPU is ~10x cheaper,
+    which is the control-plane latency floor at np >= 16 on a one-core
+    launcher host) and this module's Python ``_KVHandler`` (fallback when
+    the native build is unavailable, or forced with
+    ``HVD_TPU_KV_SERVER=python``).  Both keep the store readable through
+    ``get``/``scan_scope`` after ``stop()`` — launcher code gathers worker
+    results after shutdown (runner/__init__.py)."""
 
     def __init__(self, verbose: bool = False):
         self.httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._native = None
+        self._cache: Optional[dict] = None
+        self._lock: Optional[threading.Lock] = None
 
     def start(self, port: int = 0) -> int:
+        if os.environ.get("HVD_TPU_KV_SERVER", "native") != "python":
+            try:
+                from ..csrc import NativeKVServer
+                native = NativeKVServer()
+                bound = native.start(port)
+                self._native = native
+                return bound
+            except Exception as e:
+                get_logger().warning(
+                    "native KV server unavailable (%s); falling back to "
+                    "the Python server", e)
         self.httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
-        self.httpd.cache = {}
-        self.httpd.cache_lock = threading.Lock()
+        self.httpd.cache = self._cache = {}
+        self.httpd.cache_lock = self._lock = threading.Lock()
         # Long-poll waiters sleep on per-scope conditions (all sharing the
         # cache lock); a PUT wakes only its scope's waiters.
         # daemon_threads so a blocked long-poll never prevents interpreter
@@ -304,25 +328,38 @@ class KVStoreServer:
 
     @property
     def port(self) -> int:
+        if self._native is not None:
+            return self._native.port
         return self.httpd.server_address[1]
 
     def put(self, scope: str, key: str, value: bytes):
-        with self.httpd.cache_lock:
-            self.httpd.cache.setdefault(scope, {})[key] = value
-            c = self.httpd.scope_conds.get(scope)
-            if c is not None:
-                c.notify_all()
+        if self._native is not None:
+            self._native.put(scope, key, value)
+            return
+        with self._lock:
+            self._cache.setdefault(scope, {})[key] = value
+            if self.httpd is not None:
+                c = self.httpd.scope_conds.get(scope)
+                if c is not None:
+                    c.notify_all()
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        with self.httpd.cache_lock:
-            return self.httpd.cache.get(scope, {}).get(key)
+        if self._native is not None:
+            return self._native.get(scope, key)
+        with self._lock:
+            return self._cache.get(scope, {}).get(key)
 
     def scan_scope(self, scope: str) -> Dict[str, bytes]:
         """Server-side scope snapshot (no HTTP round-trip)."""
-        with self.httpd.cache_lock:
-            return dict(self.httpd.cache.get(scope, {}))
+        if self._native is not None:
+            return self._native.scan_scope(scope)
+        with self._lock:
+            return dict(self._cache.get(scope, {}))
 
     def stop(self):
+        if self._native is not None:
+            self._native.stop()
+            return
         if self.httpd:
             self.httpd.shutdown()
             self.httpd.server_close()
@@ -365,21 +402,20 @@ class KVStoreClient:
         self._local = threading.local()
 
     def _conn(self, fresh: bool = False):
-        import http.client
-        conn = getattr(self._local, "conn", None)
-        if conn is None or fresh:
-            if conn is not None:
+        sock = getattr(self._local, "sock", None)
+        if sock is None or fresh:
+            if sock is not None:
                 try:
-                    conn.close()
+                    sock.close()
                 except Exception:
                     pass
-            conn = http.client.HTTPConnection(self.addr, self.port,
-                                              timeout=30)
-            conn.connect()
+            sock = socket.create_connection((self.addr, self.port),
+                                            timeout=30)
             # Mirror the server's TCP_NODELAY (see _KVHandler docstring).
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._local.conn = conn
-        return conn
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+            self._local.buf = b""
+        return sock
 
     @staticmethod
     def _path(scope: str, key: str = "") -> str:
@@ -395,18 +431,54 @@ class KVStoreClient:
         return "/" + enc
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None):
-        import http.client
+        """Hand-rolled HTTP/1.1 over the persistent per-thread socket.
+        ``http.client`` cost ~80 us of host CPU per request — on the
+        launcher's one core that overhead, times np, IS the control-plane
+        latency floor (csrc/kv_server.cc header); this minimal writer/parser
+        runs ~25 us against the same servers."""
+        req = (f"{method} {path} HTTP/1.1\r\nHost: {self.addr}\r\n"
+               f"Content-Length: {len(body) if body else 0}\r\n\r\n"
+               .encode("ascii"))
+        if body:
+            req += body
         for attempt in (0, 1):
-            conn = self._conn(fresh=attempt > 0)
+            sock = self._conn(fresh=attempt > 0)
             try:
-                conn.request(method, path, body=body)
-                resp = conn.getresponse()
-                data = resp.read()  # drain so the connection is reusable
-                return resp.status, data
-            except (http.client.HTTPException, ConnectionError, OSError):
+                sock.sendall(req)
+                return self._read_response(sock)
+            except (ConnectionError, OSError):
                 if attempt:
                     raise
         raise AssertionError("unreachable")
+
+    def _read_response(self, sock):
+        """Parse one response: status line + headers + Content-Length body
+        (both servers always send Content-Length; leftover bytes stay in
+        the per-thread buffer for the next response)."""
+        buf = self._local.buf
+        while True:
+            end = buf.find(b"\r\n\r\n")
+            if end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("KV server closed the connection")
+            buf += chunk
+        head, rest = buf[:end], buf[end + 4:]
+        status_line, _, header_block = head.partition(b"\r\n")
+        status = int(status_line.split(b" ", 2)[1])
+        clen = 0
+        for line in header_block.split(b"\r\n"):
+            if line[:15].lower() == b"content-length:":
+                clen = int(line[15:])
+                break
+        while len(rest) < clen:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("KV server closed mid-body")
+            rest += chunk
+        self._local.buf = rest[clen:]
+        return status, rest[:clen]
 
     def put(self, scope: str, key: str, value: bytes):
         status, _ = self._request("PUT", self._path(scope, key), body=value)

@@ -19,7 +19,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_abi_version():
-    assert csrc.lib().hvd_core_abi_version() == 1
+    # Keep in lockstep with csrc._ABI: lib() rebuilds a stale .so by
+    # comparing against it, so a drifting constant would mask real skew.
+    assert csrc.lib().hvd_core_abi_version() == csrc._ABI
 
 
 # -- ResponseCache -----------------------------------------------------------

@@ -152,93 +152,148 @@ def test_host_assignments_partial_use():
 
 
 # -- KV store / rendezvous (http_server.py) ---------------------------------
+#
+# Every endpoint test runs against BOTH servers — the C++ one
+# (csrc/kv_server.cc, the default) and the Python fallback — pinning wire-
+# protocol parity between them.
 
-def test_kvstore_put_get_roundtrip():
+@pytest.fixture(params=["native", "python"])
+def kv_srv(request, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_KV_SERVER", request.param)
     srv = KVStoreServer()
     port = srv.start()
-    try:
-        client = KVStoreClient("127.0.0.1", port)
-        client.put("scope1", "key1", b"value1")
-        assert client.get("scope1", "key1") == b"value1"
-        assert client.get("scope1", "missing") is None
-        assert client.get("other", "key1") is None
-    finally:
-        srv.stop()
+    if request.param == "native":
+        # A silent fallback to Python would fake the native coverage.
+        assert srv._native is not None, "native KV server failed to start"
+    else:
+        assert srv._native is None
+    yield srv, port
+    srv.stop()
 
 
-def test_kvstore_batch_put_and_scope_delete():
+def test_kvstore_put_get_roundtrip(kv_srv):
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    client.put("scope1", "key1", b"value1")
+    assert client.get("scope1", "key1") == b"value1"
+    assert client.get("scope1", "missing") is None
+    assert client.get("other", "key1") is None
+
+
+def test_kvstore_batch_put_and_scope_delete(kv_srv):
     """Round-4 control-plane endpoints: one batch-put carries a whole
     dispatch cycle; one scope DELETE GCs a negotiation request scope."""
-    srv = KVStoreServer()
-    port = srv.start()
-    try:
-        client = KVStoreClient("127.0.0.1", port)
-        client.put_batch("b", {"k1": b"v1", "k2": b"\x00\xffbin",
-                               "sub/key": b"v3"})
-        assert client.get("b", "k1") == b"v1"
-        assert client.get("b", "k2") == b"\x00\xffbin"
-        assert client.get("b", "sub/key") == b"v3"
-        assert len(client.scan("b")) == 3
-        client.delete_scope("b")
-        assert client.scan("b") == {}
-        client.delete_scope("b")  # idempotent on a missing scope
-    finally:
-        srv.stop()
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    client.put_batch("b", {"k1": b"v1", "k2": b"\x00\xffbin",
+                           "sub/key": b"v3"})
+    assert client.get("b", "k1") == b"v1"
+    assert client.get("b", "k2") == b"\x00\xffbin"
+    assert client.get("b", "sub/key") == b"v3"
+    assert len(client.scan("b")) == 3
+    client.delete_scope("b")
+    assert client.scan("b") == {}
+    client.delete_scope("b")  # idempotent on a missing scope
 
 
-def test_kvstore_put_wait_roundtrip():
+def test_kvstore_put_wait_roundtrip(kv_srv):
     """put_wait stores the request and holds the HTTP request until the
     awaited key exists (the one-round-trip negotiation announce+await)."""
     import threading
     import time
-    srv = KVStoreServer()
-    port = srv.start()
-    try:
-        client = KVStoreClient("127.0.0.1", port)
-        # Timeout path: awaited key never appears -> None, request stored.
-        out = client.put_wait("req", "0", b"sig", "resp_scope", "verdict",
-                              wait=0.3)
-        assert out is None
-        assert client.get("req", "0") == b"sig"
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    # Timeout path: awaited key never appears -> None, request stored.
+    out = client.put_wait("req", "0", b"sig", "resp_scope", "verdict",
+                          wait=0.3)
+    assert out is None
+    assert client.get("req", "0") == b"sig"
 
-        def publish():
-            time.sleep(0.3)
-            srv.put("resp_scope", "verdict", b"ok")
+    def publish():
+        time.sleep(0.3)
+        srv.put("resp_scope", "verdict", b"ok")
 
-        threading.Thread(target=publish, daemon=True).start()
-        t0 = time.time()
-        out = KVStoreClient("127.0.0.1", port).put_wait(
-            "req", "1", b"sig1", "resp_scope", "verdict", wait=10.0)
-        assert out == b"ok"
-        assert time.time() - t0 < 5.0  # woke on publish, not timeout
-    finally:
-        srv.stop()
+    threading.Thread(target=publish, daemon=True).start()
+    t0 = time.time()
+    out = KVStoreClient("127.0.0.1", port).put_wait(
+        "req", "1", b"sig1", "resp_scope", "verdict", wait=10.0)
+    assert out == b"ok"
+    assert time.time() - t0 < 5.0  # woke on publish, not timeout
 
 
-def test_kvstore_scan_min_keys_longpoll():
+def test_kvstore_scan_min_keys_longpoll(kv_srv):
     """Scan with min_keys holds until the scope reaches the count (the
     coordinator's collect-all-requests primitive)."""
     import threading
     import time
-    srv = KVStoreServer()
-    port = srv.start()
-    try:
-        client = KVStoreClient("127.0.0.1", port)
-        srv.put("rq", "0", b"a")
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    srv.put("rq", "0", b"a")
 
-        def add_more():
-            time.sleep(0.25)
-            srv.put("rq", "1", b"b")
-            srv.put("rq", "2", b"c")
+    def add_more():
+        time.sleep(0.25)
+        srv.put("rq", "1", b"b")
+        srv.put("rq", "2", b"c")
 
-        threading.Thread(target=add_more, daemon=True).start()
-        out = client.scan("rq", wait=10.0, min_keys=3)
-        assert set(out) == {"0", "1", "2"}
-        # Timeout path returns whatever is there.
-        out = client.scan("rq", wait=0.2, min_keys=99)
-        assert len(out) == 3
-    finally:
-        srv.stop()
+    threading.Thread(target=add_more, daemon=True).start()
+    out = client.scan("rq", wait=10.0, min_keys=3)
+    assert set(out) == {"0", "1", "2"}
+    # Timeout path returns whatever is there.
+    out = client.scan("rq", wait=0.2, min_keys=99)
+    assert len(out) == 3
+
+
+def test_kvstore_unicode_and_escaped_names(kv_srv):
+    """Tensor names are user input: quotes, backslashes, unicode, '/',
+    '?', '%' must round-trip through paths, batch-put JSON, and scans."""
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    names = ['quote"backslash\\', "unicode-é中\U0001f600",
+             "query?frag#pct%20", "nested/seg/ment", "spaces and\ttabs"]
+    for i, n in enumerate(names):
+        client.put("esc", n, f"v{i}".encode())
+    for i, n in enumerate(names):
+        assert client.get("esc", n) == f"v{i}".encode()
+    assert set(client.scan("esc")) == set(names)
+    client.put_batch("escb", {n: b"x" for n in names})
+    assert set(client.scan("escb")) == set(names)
+    # Scopes take the same decoding path.
+    client.put(names[1], "k", b"scoped")
+    assert client.get(names[1], "k") == b"scoped"
+
+
+def test_kvstore_longpoll_get_and_key_delete(kv_srv):
+    """GET ?wait= long-poll wakes on PUT; DELETE of the last key GCs the
+    scope (scan shows it empty)."""
+    import threading
+    import time
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    assert client.get("lp", "k") is None  # immediate 404, no wait
+
+    def put_later():
+        time.sleep(0.25)
+        srv.put("lp", "k", b"woken")
+
+    threading.Thread(target=put_later, daemon=True).start()
+    t0 = time.time()
+    assert client.get("lp", "k", wait=10.0) == b"woken"
+    assert time.time() - t0 < 5.0
+    client.delete("lp", "k")
+    assert client.get("lp", "k") is None
+    assert client.scan("lp") == {}
+    client.delete("lp", "k")  # idempotent
+
+
+def test_kvstore_store_readable_after_stop(kv_srv):
+    """runner.run() gathers per-rank results AFTER the launcher shuts the
+    server down; both backends must keep the store readable post-stop."""
+    srv, port = kv_srv
+    client = KVStoreClient("127.0.0.1", port)
+    client.put("runresults", "0", b"rank0-result")
+    srv.stop()
+    assert srv.get("runresults", "0") == b"rank0-result"
+    assert srv.scan_scope("runresults") == {"0": b"rank0-result"}
 
 
 def test_rendezvous_publishes_slots():
