@@ -30,6 +30,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -684,6 +685,10 @@ static void accept_loop(std::shared_ptr<Server> s) {
     int fd = accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (s->stopping) break;
+      if (errno == EINTR) continue;
+      // Persistent accept errors (EMFILE under fd pressure) must not
+      // busy-spin the one launcher core the control plane depends on.
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
       continue;
     }
     {

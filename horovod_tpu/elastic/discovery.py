@@ -119,6 +119,7 @@ class HostManager:
         # Minimum slots the job needs (set by the ElasticDriver): the
         # blacklist-starvation escape keys off this, not off zero hosts.
         self.min_required = 1
+        self._readmit_warned: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     def update_available_hosts(self) -> int:
@@ -140,10 +141,18 @@ class HostManager:
             # still bounds genuine crash loops.
             for h in sorted((h for h in found_all if h not in found),
                             key=self.blacklist.blacklisted_since):
-                get_logger().warning(
-                    "discoverable capacity below minimum with hosts "
-                    "blacklisted; readmitting %r (pool-starvation escape; "
-                    "--reset-limit still bounds crash loops)", h)
+                # Rate-limit per host: while capacity stays short this
+                # branch re-fires every discovery poll, and a warning per
+                # DISCOVER_INTERVAL_S is log spam, not signal.
+                now = time.monotonic()
+                if now - self._readmit_warned.get(h, -1e9) > 60.0:
+                    self._readmit_warned[h] = now
+                    get_logger().warning(
+                        "discoverable capacity below minimum with hosts "
+                        "blacklisted; readmitting %r (pool-starvation "
+                        "escape, overrides a permanent blacklist — see "
+                        "docs/knobs.md; --reset-limit still bounds crash "
+                        "loops)", h)
                 self.blacklist.forgive(h)
                 found[h] = found_all[h]
                 if sum(found.values()) >= self.min_required:

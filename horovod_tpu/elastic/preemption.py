@@ -31,6 +31,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 from typing import Callable, Dict, Optional, Set
 
 from ..utils import get_logger
@@ -43,6 +44,10 @@ DEFAULT_METADATA_URL = ("http://metadata.google.internal/computeMetadata/"
                         "v1/instance/maintenance-event")
 
 PREEMPT_SCOPE = "preempt"
+
+#: How often an already-published marker is re-PUT (insurance against a KV
+#: restart dropping it); between refreshes an active event costs no writes.
+MARKER_REFRESH_S = 60.0
 
 
 class PreemptionSentinel:
@@ -66,6 +71,7 @@ class PreemptionSentinel:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._marked = False
+        self._marker_refresh_at = 0.0
         self._startup_reconciled = False
 
     def _poll_once(self) -> Optional[str]:
@@ -89,10 +95,24 @@ class PreemptionSentinel:
                 get_logger().warning(
                     "TPU maintenance notice on %s: %s — requesting "
                     "graceful drain", self.host, event)
+            # Publish once, then only refresh occasionally (covers a KV
+            # restart losing the marker): a re-PUT every poll for the
+            # whole maintenance window is steady needless control-plane
+            # write load.
+            now = time.monotonic()
+            if self._marked and now < self._marker_refresh_at:
+                return
             try:
                 self.client.put(PREEMPT_SCOPE, self.host, event.encode())
                 self._marked = True
+                self._marker_refresh_at = now + MARKER_REFRESH_S
             except Exception as e:
+                # Retry next poll.  A failed INITIAL publish leaves _marked
+                # False naturally; a failed REFRESH must NOT reset _marked —
+                # the marker is still stored, and forgetting it would gate
+                # off the clear branch and strand the marker (permanent
+                # host exclusion) if the event later cancels.
+                self._marker_refresh_at = now
                 get_logger().warning("could not publish preemption "
                                      "marker: %s", e)
         elif event == "NONE" and (self._marked or

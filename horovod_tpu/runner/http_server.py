@@ -448,6 +448,16 @@ class KVStoreClient:
                 return self._read_response(sock)
             except (ConnectionError, OSError):
                 if attempt:
+                    # Drop the desynced socket: a request went out, so a
+                    # LATE response may still arrive — a later request
+                    # reusing this socket would consume it as its own
+                    # (http.client raised CannotSendRequest here; the
+                    # raw-socket path must poison the connection itself).
+                    try:
+                        sock.close()
+                    except Exception:
+                        pass
+                    self._local.sock = None
                     raise
         raise AssertionError("unreachable")
 

@@ -48,6 +48,40 @@ def make_override_action(override_args):
     return StoreOverrideAction, StoreTrueOverrideAction
 
 
+def check_build() -> str:
+    """The ``--check-build`` matrix (reference runner/launch.py:110
+    check_build), answered from the core's built/enabled surface
+    (core.py:365-417): one framework (JAX) and one tensor-op backend (XLA
+    collectives) are the design — the legacy rows print unchecked, in the
+    reference's own format, so capability-probing scripts read the truth."""
+    from .. import core
+
+    def c(v):
+        return "X" if v else " "
+
+    return f"""\
+Horovod-TPU v{__version__}:
+
+Available Frameworks:
+    [X] JAX
+    [ ] TensorFlow
+    [ ] PyTorch
+    [ ] MXNet
+
+Available Controllers:
+    [{c(core.xla_enabled())}] XLA (KV rendezvous + jax.distributed)
+    [{c(core.mpi_enabled())}] MPI
+    [{c(core.gloo_enabled())}] Gloo
+
+Available Tensor Operations:
+    [{c(core.xla_built())}] XLA collectives (ICI/DCN)
+    [{c(core.nccl_built())}] NCCL
+    [{c(core.ddl_built())}] DDL
+    [{c(core.ccl_built())}] CCL
+    [{c(core.mpi_built())}] MPI
+    [{c(core.gloo_built())}] Gloo"""
+
+
 def parse_args(argv=None):
     """Flag surface mirroring runner/launch.py:286-578."""
     override_args = set()
@@ -58,6 +92,10 @@ def parse_args(argv=None):
         description="Horovod-compatible launcher for the TPU-native runtime.")
     parser.add_argument("-v", "--version", action="version",
                         version=__version__)
+    parser.add_argument("-cb", "--check-build", action="store_true",
+                        dest="check_build",
+                        help="Print the framework/controller/tensor-op "
+                             "build matrix and exit.")
     parser.add_argument("-np", "--num-proc", dest="np", type=int,
                         help="Total number of training processes.")
     parser.add_argument("-p", "--ssh-port", dest="ssh_port", type=int,
@@ -172,6 +210,9 @@ def parse_args(argv=None):
 
     args = parser.parse_args(argv)
     args.override_args = override_args
+    if args.check_build:
+        print(check_build())
+        raise SystemExit(0)
     # Honest no-op/unsupported handling (reference launch.py:747
     # run_controller chooses gloo/mpi/jsrun; here there is exactly one
     # backend).  Silent acceptance would let an --mpi user assume mpirun

@@ -55,6 +55,9 @@ _SCOPE_RESULTS = "se_results"  # {world_version}/{rank} -> pickle(result)
 
 _HEARTBEAT_S = 2.0
 _ALIVE_WINDOW_S = 10.0
+# How long the caller waits after driver.join() for the final world's
+# result records to land (see run_elastic's ResultsRecorder note).
+_RESULT_WAIT_S = 30.0
 
 _BOOTSTRAP = r"""
 import os, pickle, sys, urllib.request
@@ -460,17 +463,32 @@ def run_elastic(fn: Callable,
         if driver.error_message:
             raise RuntimeError(driver.error_message)
         final = driver.world_version
-        raw_results = client.scan(_SCOPE_RESULTS)
-        results = {int(k.split("/")[1]): pickle.loads(v)
-                   for k, v in raw_results.items()
-                   if k.startswith(f"{final}/")}
         expected = {s.rank for s in driver.current_assignments()}
-        missing = sorted(expected - set(results))
-        if missing:
-            raise RuntimeError(
-                f"spark elastic finished but ranks {missing} reported no "
-                f"result for final world {final} "
-                f"(result keys present: {sorted(raw_results)})")
+        # ResultsRecorder semantics (runner/elastic/driver.py:113
+        # get_results): conclude only after every final-world rank's
+        # result is RECORDED, not merely after every worker exited.  A
+        # rejoined incarnation's result PUT travels a different socket
+        # than its done marker, so under host load the publication can
+        # trail the driver's finished-check by a scheduling quantum —
+        # poll briefly instead of failing on the first scan (the r4
+        # in-suite flake).  The wait is bounded: a rank that truly never
+        # published (crashed mid-PUT) still surfaces the forensic error.
+        deadline = time.monotonic() + _RESULT_WAIT_S
+        while True:
+            raw_results = client.scan(_SCOPE_RESULTS)
+            results = {int(k.split("/")[1]): pickle.loads(v)
+                       for k, v in raw_results.items()
+                       if k.startswith(f"{final}/")}
+            missing = sorted(expected - set(results))
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"spark elastic finished but ranks {missing} reported "
+                    f"no result for final world {final} within "
+                    f"{_RESULT_WAIT_S:.0f}s "
+                    f"(result keys present: {sorted(raw_results)})")
+            time.sleep(0.1)
         return [results[r] for r in sorted(expected)]
     finally:
         client.put(_SCOPE_CTL, "shutdown", b"1")

@@ -84,6 +84,12 @@ class RowGroupStream:
     tests can assert the bounded-memory contract on shards much larger
     than the budget."""
 
+    # Open-file cache bound: a shard spanning hundreds of Parquet files
+    # must not hold one fd per file for the fit's lifetime (the bounded-
+    # resource claim covers descriptors too); a few stay open because the
+    # per-epoch group shuffle revisits files in mixed order.
+    MAX_OPEN_FILES = 4
+
     def __init__(self, units, feature_cols, label_cols, filesystem=None,
                  seed: int = 0):
         self.units = list(units)
@@ -91,16 +97,43 @@ class RowGroupStream:
         self.label_cols = list(label_cols)
         self.filesystem = filesystem
         self.seed = seed
-        self._files: dict = {}
+        self._files: dict = {}  # insertion-ordered: LRU eviction
         self.peak_rows_resident = 0
 
     def _pf(self, f):
-        if f not in self._files:
-            import pyarrow.parquet as pq
-            src = self.filesystem.open(f, "rb") \
-                if self.filesystem is not None else f
-            self._files[f] = pq.ParquetFile(src)
-        return self._files[f]
+        if f in self._files:
+            entry = self._files.pop(f)  # re-insert: most-recently-used
+            self._files[f] = entry
+            return entry[0]
+        while len(self._files) >= self.MAX_OPEN_FILES:
+            self._close_one(next(iter(self._files)))
+        import pyarrow.parquet as pq
+        src = self.filesystem.open(f, "rb") \
+            if self.filesystem is not None else f
+        pf = pq.ParquetFile(src)
+        self._files[f] = (pf, src if src is not f else None)
+        return pf
+
+    def _close_one(self, f) -> None:
+        pf, src = self._files.pop(f)
+        for h in (pf, src):
+            if h is None:
+                continue
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        """Release every open Parquet handle (idempotent)."""
+        for f in list(self._files):
+            self._close_one(f)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def num_rows(self) -> int:
         """Total rows across the shard, from metadata only (no data read)."""
@@ -170,28 +203,43 @@ def _estimator_train_fn(cfg: dict) -> List[dict]:
     hvd.init()
     rank, size = hvd.rank(), hvd.size()
     store: Store = cfg["store"]
+
+    import contextlib
+    with contextlib.ExitStack() as streams:
+        fs = store.fs()
+        units = shard_row_groups(store.get_parquet_files(cfg["train_path"]),
+                                 rank, size, filesystem=fs)
+        stream = streams.enter_context(
+            RowGroupStream(units, cfg["feature_cols"], cfg["label_cols"],
+                           filesystem=fs, seed=cfg["seed"] + rank))
+        total_rows = stream.num_rows()
+        if total_rows == 0:
+            raise ValueError(
+                f"rank {rank} received no parquet row groups; write the "
+                f"training data with at least {size} row groups "
+                f"(row_group_size small enough) or lower num_proc")
+        vstream = None
+        if cfg.get("val_path"):
+            vunits = shard_row_groups(
+                store.get_parquet_files(cfg["val_path"]), rank, size,
+                filesystem=fs)
+            vstream = streams.enter_context(
+                RowGroupStream(vunits, cfg["feature_cols"],
+                               cfg["label_cols"], filesystem=fs))
+        return _estimator_train_loop(cfg, stream, vstream, total_rows)
+
+
+def _estimator_train_loop(cfg, stream, vstream, total_rows) -> List[dict]:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    rank = hvd.rank()
+    store: Store = cfg["store"]
     model, loss_fn = cfg["model"], _resolve_loss(cfg["loss"])
     batch = cfg["batch_size"]
-
-    fs = store.fs()
-    units = shard_row_groups(store.get_parquet_files(cfg["train_path"]),
-                             rank, size, filesystem=fs)
-    stream = RowGroupStream(units, cfg["feature_cols"], cfg["label_cols"],
-                            filesystem=fs, seed=cfg["seed"] + rank)
-    total_rows = stream.num_rows()
-    if total_rows == 0:
-        raise ValueError(
-            f"rank {rank} received no parquet row groups; write the "
-            f"training data with at least {size} row groups "
-            f"(row_group_size small enough) or lower num_proc")
-    vstream = None
-    if cfg.get("val_path"):
-        vunits = shard_row_groups(
-            store.get_parquet_files(cfg["val_path"]), rank, size,
-            filesystem=fs)
-        vstream = RowGroupStream(vunits, cfg["feature_cols"],
-                                 cfg["label_cols"], filesystem=fs)
-
     X0, _ = next(stream.iter_batches(min(batch, total_rows), epoch=0,
                                      shuffle=False))
     params = model.init(jax.random.PRNGKey(cfg["seed"]),

@@ -103,6 +103,22 @@ def test_mpi_gloo_noop_flags_warn(capsys):
     assert "--gloo is accepted for compatibility and ignored" in err
 
 
+def test_check_build_prints_matrix(capsys):
+    """--check-build (reference runner/launch.py:110): the matrix answers
+    from the core's built/enabled surface — one framework, one backend."""
+    with pytest.raises(SystemExit) as ei:
+        parse_args(["--check-build"])
+    assert ei.value.code == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "[ ] PyTorch" in out
+    assert "Available Controllers" in out
+    assert "Available Tensor Operations" in out
+    assert "[X] XLA collectives" in out
+    assert "[ ] NCCL" in out
+
+
 def test_jsrun_flag_errors_with_migration_pointer(capsys):
     """LSF/jsrun launch (reference runner/js_run.py:32) is out of scope by
     design; the launcher must fail loudly with the migration pointer, not
@@ -485,7 +501,7 @@ def test_run_api_prefers_kv_results(monkeypatch):
 
     def spy(args, on_rendezvous=None):
         def cap(rdv):
-            seen["kv"] = rdv.httpd.cache
+            seen["server"] = rdv  # store stays readable post-stop
             if on_rendezvous is not None:
                 on_rendezvous(rdv)
         return orig(args, on_rendezvous=cap)
@@ -493,7 +509,7 @@ def test_run_api_prefers_kv_results(monkeypatch):
     monkeypatch.setattr(runner_mod, "_run_static", spy)
     out = runner_mod.run(lambda: int(os.environ["HOROVOD_RANK"]) * 10, np=2)
     assert out == [0, 10]
-    assert set(seen["kv"].get("runresults", {})) == {"0", "1"}
+    assert set(seen["server"].scan_scope("runresults")) == {"0", "1"}
 
 
 def test_spark_run_env_injection_mocked(monkeypatch):
