@@ -184,13 +184,25 @@ class ResNet(nn.Module):
     block_cls: ModuleDef = BottleneckBlock
     s2d_stem: bool = False       # space-to-depth re-indexed stem conv
     eq_pool_grad: bool = False   # maxpool backward without select_and_scatter
+    fused_bn: bool = True        # f32-stats / bf16-apply folded batch norm
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, dtype=self.dtype, padding="SAME")
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
-                       axis_name=self.axis_name if train else None)
+        if self.fused_bn:
+            # FusedBatchNorm (sync_batch_norm.py): f32 statistics, folded
+            # per-channel scale/offset applied in the activation dtype, so
+            # the BN+ReLU+add epilogue fuses with its conv neighbors
+            # instead of a standalone f32 normalize chain (PERF_r02's
+            # BN-chain headroom; same param/stat tree as flax BatchNorm).
+            from ..sync_batch_norm import FusedBatchNorm
+            norm = partial(FusedBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           axis_name=self.axis_name if train else None)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                           axis_name=self.axis_name if train else None)
         x = x.astype(self.dtype)
         if self.s2d_stem:
             x = SpaceToDepthStem(self.num_filters, dtype=self.dtype,
@@ -238,9 +250,12 @@ def migrate_pre_r3_checkpoint(params):
 
 
 def create_resnet50(num_classes: int = 1000, dtype=jnp.bfloat16,
-                    sync_bn: bool = False, fast_stem: bool = False):
+                    sync_bn: bool = False, fast_stem: bool = False,
+                    fused_bn: bool = True):
     """``fast_stem=True`` enables the two TPU stem optimizations
-    (SpaceToDepthStem + max_pool_eq_grad) — same math, same param tree."""
+    (SpaceToDepthStem + max_pool_eq_grad); ``fused_bn`` (default) uses the
+    f32-stats/bf16-apply folded batch norm — same math, same param tree."""
     return ResNet50(num_classes=num_classes, dtype=dtype,
                     axis_name="hvd" if sync_bn else None,
-                    s2d_stem=fast_stem, eq_pool_grad=fast_stem)
+                    s2d_stem=fast_stem, eq_pool_grad=fast_stem,
+                    fused_bn=fused_bn)
