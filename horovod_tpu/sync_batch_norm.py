@@ -34,16 +34,20 @@ def sync_batch_stats(x: jax.Array,
     if reduction_axes is None:
         reduction_axes = tuple(range(x.ndim - 1))  # all but features
     members = process_set.members() if process_set is not None else None
-    feat = x.shape[-1]
     n_local = 1
     for a in reduction_axes:
         n_local *= x.shape[a]
     s = jnp.sum(x, axis=reduction_axes)
     sq = jnp.sum(jnp.square(x), axis=reduction_axes)
     from .ops import collective_ops as C
-    vec = jnp.concatenate([s, sq, jnp.full((1,), n_local, x.dtype)])
+    # Flatten so ANY reduction_axes (stats of any rank) ride the single
+    # collective; reshape back after the split.
+    shape, k = s.shape, s.size
+    vec = jnp.concatenate([s.ravel(), sq.ravel(),
+                           jnp.full((1,), n_local, x.dtype)])
     vec = C.allreduce(vec, C.Sum, axis_name=axis_name, members=members)
-    s, sq, cnt = vec[:feat], vec[feat:2 * feat], vec[-1]
+    s, sq, cnt = (vec[:k].reshape(shape), vec[k:2 * k].reshape(shape),
+                  vec[-1])
     mean = s / cnt
     # Clamp: the E[x^2]-E[x]^2 form can go epsilon-negative in finite
     # precision, and rsqrt(var + eps) downstream must not see it.
@@ -51,7 +55,7 @@ def sync_batch_stats(x: jax.Array,
     return mean, var
 
 
-class FusedBatchNorm:
+def FusedBatchNorm(**kwargs):
     """Batch norm with float32 statistics and a bf16-foldable epilogue —
     the TPU-shaped batch norm (flax-compatible param/stat tree).
 
@@ -76,11 +80,9 @@ class FusedBatchNorm:
       standalone f32 normalize kernel (VERDICT r4 next-step #5; pinned by
       tests/test_models.py's compiled-HLO kernel-count check).
 
-    Declared as a plain factory returning a flax module (built lazily so
-    importing this file does not import flax)."""
-
-    def __new__(cls, **kwargs):
-        return _fused_bn_cls()(**kwargs)
+    A plain factory returning a flax module instance (the class is built
+    lazily so importing this file does not import flax)."""
+    return _fused_bn_cls()(**kwargs)
 
 
 def _fused_bn_cls():

@@ -293,7 +293,7 @@ def test_fused_bn_sync_stats_one_psum(hvd8):
     the reference allreduces mean and variance separately,
     tensorflow/sync_batch_norm.py:22)."""
     import re as _re
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
     ref, fused = _bn_pair(use_running_average=False, axis_name="hvd")
     x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 4, 8)
                     .astype(np.float32))
@@ -386,3 +386,20 @@ def test_resnet_fused_bn_param_tree_compatible():
     y0, _ = m0.apply(vs[0], x, train=True, mutable=["batch_stats"])
     y1, _ = m1.apply(vs[0], x, train=True, mutable=["batch_stats"])
     np.testing.assert_allclose(y0, y1, atol=2e-5)
+
+
+def test_sync_batch_stats_arbitrary_reduction_axes(hvd8):
+    """The one-psum concat must not narrow the public contract: stats of
+    any rank (any reduction_axes) ride the single collective."""
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 6, 3)
+                    .astype(np.float32))
+
+    def f(xb):
+        return hvd.sync_batch_stats(xb, reduction_axes=(0, 1))
+
+    step = jax.jit(jax.shard_map(
+        f, mesh=hvd8.mesh(), in_specs=P("hvd"), out_specs=(P(), P())))
+    m, v = step(x)
+    assert m.shape == (6, 3)
+    np.testing.assert_allclose(m, x.mean(axis=(0, 1)), atol=1e-5)
+    np.testing.assert_allclose(v, x.var(axis=(0, 1)), atol=1e-5)
