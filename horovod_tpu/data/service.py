@@ -2,27 +2,50 @@
 
 Reference: the Horovod-managed ``tf.data.experimental.service`` cluster
 (runner/common/service/compute_service.py:99 ComputeService — an RPC
-registry of dispatchers and workers — plus tensorflow/data/
-compute_service.py's send/read sides).  SURVEY.md §7 marks a TPU analog
-optional; this is the minimal honest version: dedicated CPU-heavy hosts run
-``serve_dataset`` (a batch producer + HTTP endpoint registered in the
-rendezvous KV store), and each trainer iterates ``RemoteDataset`` which
-round-robins pickled batches from the registered producers — decoupling
-input preprocessing from accelerator hosts the way the reference's data
-service does.
+registry of dispatchers and workers with registration waits and shutdown
+propagation — plus tensorflow/data/compute_service.py's send/read sides).
+TPU analog: dedicated CPU-heavy hosts run ``serve_dataset`` (a batch
+producer + HTTP endpoint), and each trainer iterates ``RemoteDataset``,
+which round-robins pickled batches across the registered producers —
+decoupling input preprocessing from accelerator hosts the way the
+reference's data service does.
+
+Registry semantics (round 5, the ComputeService contract this module
+implements over the rendezvous KV instead of an RPC service):
+
+* producers REGISTER with a heartbeat — the record carries
+  ``{addr, ts}`` and a daemon refreshes ``ts`` every
+  ``HEARTBEAT_S``; ``stop()`` deregisters explicitly (graceful), a
+  crashed producer just stops heartbeating;
+* consumers discover producers FROM THE REGISTRY each sweep, so
+  late-joining producers are picked up mid-epoch (the reference's
+  WaitForDispatcherRegistration shape without the fixed-id slots);
+* DEAD-PRODUCER EVICTION: a connection failure to a producer whose
+  heartbeat is stale (older than ``alive_window_s``) evicts it — the
+  trainer completes the epoch from the survivors (its undelivered
+  batches are lost, exactly the reference's at-most-once data-service
+  delivery); a failure with a FRESH heartbeat is treated as transient
+  and retried.
 """
 
 from __future__ import annotations
 
+import json
 import pickle
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Iterable, Iterator, List, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 from ..runner.http_server import KVStoreClient
+from ..utils import get_logger
 
 REGISTRY_SCOPE = "dataservice"
+
+#: Producer heartbeat period / consumer liveness window.
+HEARTBEAT_S = 2.0
+ALIVE_WINDOW_S = 10.0
 
 
 class _BatchHandler(BaseHTTPRequestHandler):
@@ -58,16 +81,19 @@ class _BatchHandler(BaseHTTPRequestHandler):
 class DataServiceWorker:
     """One producer endpoint (the reference's data-service *worker*): pulls
     batches from an iterable on a background thread, serves them over HTTP,
-    registers itself in the rendezvous KV store."""
+    registers itself — with a heartbeat — in the rendezvous KV store."""
 
     def __init__(self, dataset: Iterable[Any], worker_id: int = 0,
                  rendezvous_addr: Optional[str] = None,
                  rendezvous_port: Optional[int] = None,
-                 queue_size: int = 8):
+                 queue_size: int = 8,
+                 heartbeat_s: float = HEARTBEAT_S):
         self.dataset = dataset
         self.worker_id = worker_id
         self._rdv = (rendezvous_addr, rendezvous_port)
         self._queue_size = queue_size
+        self._heartbeat_s = heartbeat_s
+        self._stop_hb = threading.Event()
         self.httpd: Optional[ThreadingHTTPServer] = None
 
     def start(self) -> int:
@@ -93,12 +119,42 @@ class DataServiceWorker:
         if addr and rport:
             import socket
             my = socket.gethostbyname(socket.gethostname())
-            KVStoreClient(addr, int(rport)).put(
-                REGISTRY_SCOPE, f"worker/{self.worker_id}",
-                f"{my}:{port}".encode())
+            client = KVStoreClient(addr, int(rport))
+            key = f"worker/{self.worker_id}"
+            endpoint = f"{my}:{port}"
+
+            def put_record():
+                client.put(REGISTRY_SCOPE, key, json.dumps(
+                    {"addr": endpoint, "ts": time.time()}).encode())
+
+            put_record()  # registration IS the first heartbeat (sync, so
+            # a consumer starting right after serve_dataset() returns
+            # already sees this producer)
+
+            def hb_loop():
+                while not self._stop_hb.wait(self._heartbeat_s):
+                    try:
+                        put_record()
+                    except Exception as e:
+                        get_logger().debug(
+                            "data-service heartbeat failed: %s", e)
+
+            threading.Thread(target=hb_loop, daemon=True,
+                             name=f"hvd-data-hb-{self.worker_id}").start()
         return port
 
     def stop(self):
+        """Graceful shutdown: deregister, then stop serving.  A CRASHED
+        producer never runs this — its registry record simply goes stale
+        and consumers evict it after ``alive_window_s``."""
+        self._stop_hb.set()
+        addr, rport = self._rdv
+        if addr and rport:
+            try:
+                KVStoreClient(addr, int(rport)).delete(
+                    REGISTRY_SCOPE, f"worker/{self.worker_id}")
+            except Exception:
+                pass
         if self.httpd:
             self.httpd.shutdown()
             self.httpd.server_close()
@@ -117,43 +173,149 @@ def serve_dataset(dataset: Iterable[Any], worker_id: int = 0,
 
 class RemoteDataset:
     """Trainer-side iterator (send_to_data_service read side): round-robins
-    /next across endpoints until every producer reports exhaustion."""
+    /next across live producers until every one is exhausted or evicted.
+
+    With a rendezvous address, producers are discovered from the registry
+    EVERY sweep (late joiners serve the tail of the epoch; stale-heartbeat
+    producers are skipped).  A connection failure evicts the producer only
+    when its heartbeat is stale — transient failures of a live producer
+    are retried.  With a static ``endpoints`` list (no registry), eviction
+    falls back to ``max_failures`` consecutive connection errors."""
 
     def __init__(self, endpoints: Optional[List[str]] = None,
                  rendezvous_addr: Optional[str] = None,
                  rendezvous_port: Optional[int] = None,
-                 num_workers: int = 1):
+                 num_workers: int = 1,
+                 alive_window_s: float = ALIVE_WINDOW_S,
+                 max_failures: int = 5):
+        self._client = None
+        self._alive_window = alive_window_s
+        self._max_failures = max_failures
+        self._static = endpoints
+        # Heartbeat freshness is judged on the CONSUMER's clock by watching
+        # the ts VALUE change (endpoint -> (last ts seen, local time it
+        # changed)) — comparing a producer-host timestamp against this
+        # host's clock would mark live producers dead under clock skew
+        # larger than the window.
+        self._hb_seen: Dict[str, tuple] = {}
         if endpoints is None:
             if not (rendezvous_addr and rendezvous_port):
                 raise ValueError("pass endpoints or a rendezvous address")
-            client = KVStoreClient(rendezvous_addr, int(rendezvous_port))
-            endpoints = []
-            for w in range(num_workers):
-                raw = client.get(REGISTRY_SCOPE, f"worker/{w}")
-                if raw:
-                    endpoints.append(raw.decode())
-        if not endpoints:
+            self._client = KVStoreClient(rendezvous_addr,
+                                         int(rendezvous_port))
+            # num_workers is kept for API compat; the registry is
+            # authoritative.  ``endpoints`` is the discovery snapshot at
+            # construction — iteration re-discovers every sweep.
+            snap = self._registry()
+            if not snap:
+                raise ValueError("no data-service endpoints registered")
+            self.endpoints = list(snap)
+        elif not endpoints:
             raise ValueError("no data-service endpoints registered")
-        self.endpoints = endpoints
+        else:
+            self.endpoints = list(endpoints)
+
+    def _registry(self) -> Optional[List[str]]:
+        """Fresh-heartbeat producer endpoints from the registry, or None
+        when the registry itself is UNREACHABLE — callers must treat None
+        as "unknown" (keep the last view, evict nothing), never as "all
+        producers gone": a KV blip mid-epoch must not silently end the
+        epoch with batches undelivered."""
+        try:
+            records = sorted(self._client.scan(REGISTRY_SCOPE).items())
+        except Exception as e:
+            get_logger().warning(
+                "data-service registry unreachable (treating producer "
+                "liveness as unknown this sweep): %s", e)
+            return None
+        now = time.monotonic()
+        out = []
+        for key, raw in records:
+            if not key.startswith("worker/"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            ep = rec.get("addr")
+            seen = self._hb_seen.get(ep)
+            if seen is None or seen[0] != rec.get("ts"):
+                self._hb_seen[ep] = (rec.get("ts"), now)
+                out.append(ep)
+            elif now - seen[1] <= self._alive_window:
+                out.append(ep)
+        return out
 
     def __iter__(self) -> Iterator[Any]:
         import urllib.error
         import urllib.request
-        live = list(self.endpoints)
-        while live:
-            for ep in list(live):
+        exhausted: set = set()
+        evicted: set = set()
+        failures: Dict[str, int] = {}
+        known = list(self.endpoints if self._client is None
+                     else self._registry() or [])
+        while True:
+            if self._client is not None:
+                reg = self._registry()
+                if reg is not None:
+                    known = reg
+                # reg None = registry unreachable: keep the last-known
+                # view (evict nothing, end nothing) and keep trying.
+            live = [ep for ep in known
+                    if ep not in exhausted and ep not in evicted]
+            if not live:
+                return
+            progress = False
+            for ep in live:
                 try:
                     resp = urllib.request.urlopen(f"http://{ep}/next",
                                                   timeout=60)
-                    # 204 = producer drained-but-alive (queue empty for the
-                    # server's wait window): retry later.  urllib raises
-                    # HTTPError only for status >= 400, so this must be an
-                    # explicit status check, not an except branch.
+                    # Any answered request proves the producer alive:
+                    # reset its failure streak BEFORE the status check
+                    # (a 204 drained-but-alive reply is a success, not a
+                    # step toward 'consecutive failures').
+                    failures.pop(ep, None)
+                    # 204 = queue empty for the server's wait window:
+                    # retry later.  urllib raises HTTPError only for
+                    # status >= 400, so this must be an explicit status
+                    # check, not an except branch.
                     if resp.status == 204:
                         continue
+                    progress = True
                     yield pickle.loads(resp.read())
                 except urllib.error.HTTPError as e:
                     if e.code == 410:  # producer exhausted: drop endpoint
-                        live.remove(ep)
+                        failures.pop(ep, None)
+                        exhausted.add(ep)
                     else:
                         raise
+                except (urllib.error.URLError, ConnectionError,
+                        TimeoutError, OSError) as e:
+                    if self._evict(ep, failures, e):
+                        evicted.add(ep)
+            if not progress:
+                time.sleep(0.2)
+
+    def _evict(self, ep: str, failures: Dict[str, int],
+               err: Exception) -> bool:
+        """Decide whether a connection failure means DEAD (evict) or
+        transient (retry): registry mode checks the heartbeat, static
+        mode counts consecutive failures."""
+        if self._client is not None:
+            reg = self._registry()
+            if reg is None or ep in reg:
+                # Heartbeat fresh — or registry unreachable (unknown
+                # liveness must not evict a possibly-live producer).
+                return False
+            get_logger().warning(
+                "data-service producer %s unreachable with a stale "
+                "heartbeat; evicting (its undelivered batches are lost, "
+                "the epoch completes from the survivors): %s", ep, err)
+            return True
+        failures[ep] = failures.get(ep, 0) + 1
+        if failures[ep] >= self._max_failures:
+            get_logger().warning(
+                "data-service producer %s failed %d consecutive "
+                "connections; evicting: %s", ep, failures[ep], err)
+            return True
+        return False

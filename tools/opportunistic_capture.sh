@@ -29,7 +29,11 @@ while true; do
     # silenced this watcher entirely with a bare `pgrep -f pytest`).
     # Tradeoff: a wrapper quoting "pytest" within its first ten tokens
     # would pause probing; none such runs here.
-    if ps -eo args= | awk '{ for (i = 1; i <= 10 && i <= NF; i++)
+    # OPP_TEST_MODE=1 (tests/test_opportunistic_watcher.py) bypasses the
+    # pytest pause — the test itself runs under pytest, which would
+    # otherwise park this loop forever.
+    if [ "${OPP_TEST_MODE:-0}" != "1" ] && \
+       ps -eo args= | awk '{ for (i = 1; i <= 10 && i <= NF; i++)
                                  if ($i ~ /(^|\/)pytest$/) f = 1 }
                            END { exit !f }'; then
         sleep 60
@@ -78,5 +82,8 @@ EOF
     else
         echo "probe failed $(date -u +%FT%TZ)" >> "$LOG"
     fi
+    # Tests drive exactly one loop iteration; rc=3 means "battery ran but
+    # no fresh capture" without sleeping out the 120 s retry.
+    [ "${OPP_LOOP_ONCE:-0}" = "1" ] && exit 3
     sleep 120
 done
