@@ -42,6 +42,21 @@ class SlotInfo:
     def from_dict(d: dict) -> "SlotInfo":
         return SlotInfo(**d)
 
+    def env(self) -> Dict[str, str]:
+        """The per-worker HOROVOD_* identity env for this slot — the ONE
+        place that owns the slot-to-env contract (used by the launcher's
+        per-slot spawn and by the jsrun shim; gloo_run.py:66-78)."""
+        from .. import config as _config
+        return {
+            _config.HOROVOD_RANK: str(self.rank),
+            _config.HOROVOD_SIZE: str(self.size),
+            _config.HOROVOD_LOCAL_RANK: str(self.local_rank),
+            _config.HOROVOD_LOCAL_SIZE: str(self.local_size),
+            _config.HOROVOD_CROSS_RANK: str(self.cross_rank),
+            _config.HOROVOD_CROSS_SIZE: str(self.cross_size),
+            _config.HOROVOD_HOSTNAME: self.hostname,
+        }
+
 
 def parse_hosts(hosts_string: str) -> List[HostInfo]:
     """"host1:2,host2:4" → [HostInfo] (hosts.py:22)."""

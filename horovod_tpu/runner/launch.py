@@ -121,6 +121,11 @@ def parse_args(argv=None):
     group_host.add_argument("-hostfile", "--hostfile", dest="hostfile",
                             help='Hostfile with "hostname slots=N" lines.')
 
+    parser.add_argument("--binding-args", dest="binding_args",
+                        help="jsrun binding arguments (replaces the "
+                             "generated --erf_input rankfile; reference "
+                             "launch.py --binding-args).")
+
     group_controller = parser.add_mutually_exclusive_group()
     group_controller.add_argument("--gloo", "--use-gloo", dest="use_gloo",
                                   action="store_true",
@@ -218,12 +223,33 @@ def parse_args(argv=None):
     # backend).  Silent acceptance would let an --mpi user assume mpirun
     # semantics they are not getting.
     if args.use_jsrun:
-        parser.error(
-            "jsrun/LSF launch is not supported: this framework has one "
-            "communication backend (XLA collectives) and one launcher "
-            "(ssh/loopback). Submit horovodrun inside the LSF job script "
-            "with -H/--hostfile instead — see docs/migration.md "
-            "(launchers table).")
+        # jsrun as the SPAWN TRANSPORT (reference launch.py:760
+        # run_controller -> js_run): one jsrun covers every rank; each
+        # task runs the jsrun_shim, which maps its JSM rank onto the
+        # rendezvous slot contract.  The collective backend is still XLA
+        # — there is no MPI controller to select (docs/migration.md).
+        from . import lsf
+        if not lsf.using_lsf():
+            parser.error(
+                "--jsrun requires an LSF allocation (LSB_JOBID is not "
+                "set). Outside LSF, launch with -H/--hostfile over "
+                "ssh/loopback instead — see docs/migration.md "
+                "(launchers table).")
+        if not lsf.is_jsrun_installed():
+            parser.error(
+                "--jsrun: the jsrun executable is not on PATH in this "
+                "LSF allocation.")
+        if args.min_np is not None or args.max_np is not None or \
+                args.host_discovery_script is not None:
+            # The elastic driver respawns workers per reshape over
+            # ssh/loopback; jsrun has no per-worker respawn.  Error
+            # loudly rather than silently ignoring --jsrun (the ssh
+            # fallback would hang on jsrun-only clusters).
+            parser.error(
+                "--jsrun cannot be combined with elastic flags "
+                "(--min-np/--max-np/--host-discovery-script): elastic "
+                "worlds respawn workers over ssh/loopback. Run elastic "
+                "without --jsrun, or run --jsrun static.")
     if args.use_mpi or args.use_gloo:
         flag = "--mpi" if args.use_mpi else "--gloo"
         print(f"horovodrun: note: {flag} is accepted for compatibility and "
@@ -317,14 +343,8 @@ def _worker_env(base_env: Dict[str, str], slot: _hosts.SlotInfo,
                 coordinator: str) -> Dict[str, str]:
     """Per-slot rendezvous env (gloo_run.py:66-78)."""
     env = dict(base_env)
+    env.update(slot.env())
     env.update({
-        _config.HOROVOD_RANK: str(slot.rank),
-        _config.HOROVOD_SIZE: str(slot.size),
-        _config.HOROVOD_LOCAL_RANK: str(slot.local_rank),
-        _config.HOROVOD_LOCAL_SIZE: str(slot.local_size),
-        _config.HOROVOD_CROSS_RANK: str(slot.cross_rank),
-        _config.HOROVOD_CROSS_SIZE: str(slot.cross_size),
-        _config.HOROVOD_HOSTNAME: slot.hostname,
         _config.HOROVOD_RENDEZVOUS_ADDR: rendezvous_addr,
         _config.HOROVOD_RENDEZVOUS_PORT: str(rendezvous_port),
         "HVD_TPU_COORDINATOR": coordinator,
@@ -364,8 +384,21 @@ def _run_static(args, on_rendezvous=None) -> int:
     elif args.hosts:
         host_list = _hosts.parse_hosts(args.hosts)
     else:
-        np_ = args.np or 1
-        host_list = [_hosts.HostInfo("localhost", np_)]
+        from . import lsf
+        if lsf.using_lsf() and (args.np is None or
+                                getattr(args, "use_jsrun", False)):
+            # Inside an LSF allocation the granted hosts ARE the world
+            # (reference launch.py:295 makes -np optional under LSF).
+            # An explicit -np WITHOUT --jsrun keeps the localhost
+            # default — `horovodrun -np 1` in an interactive bsub
+            # session must not ssh-fan-out across the allocation.
+            try:
+                host_list = lsf.lsf_hosts()
+            except RuntimeError as e:
+                raise SystemExit(f"horovodrun: {e}")
+        else:
+            np_ = args.np or 1
+            host_list = [_hosts.HostInfo("localhost", np_)]
     np_ = args.np or sum(h.slots for h in host_list)
     assignments = _hosts.get_host_assignments(host_list, np_)
 
@@ -437,6 +470,13 @@ def _run_static(args, on_rendezvous=None) -> int:
     base_env = {k: v for k, v in os.environ.items()}
     base_env.update(env_from_args(args))
 
+    if getattr(args, "use_jsrun", False):
+        try:
+            return _jsrun_spawn(args, assignments, base_env, addr, port,
+                                coordinator)
+        finally:
+            rendezvous.stop()
+
     threads = []
     rets = [None] * len(assignments)
     failure = threading.Event()
@@ -488,6 +528,64 @@ def _run_static(args, on_rendezvous=None) -> int:
         print(f"horovodrun: ranks failed: {bad}", file=sys.stderr)
         return bad[0][1] or 1
     return 0
+
+
+def _jsrun_spawn(args, assignments, base_env, addr, port,
+                 coordinator) -> int:
+    """Spawn every rank with ONE jsrun invocation (js_run.py:34 js_run).
+
+    The ERF rankfile (js_run.py:96 generate_jsrun_rankfile) pins each
+    rank to its assigned host; per-rank worker env comes from the
+    rendezvous ``rank/{n}`` records via the jsrun_shim (jsrun starts all
+    tasks with an identical command line, so the shim is how rank
+    identity reaches the worker — the reference gets it from the MPI
+    runtime instead).  The reference's cpu-range math rides on Summit's
+    CSM queries; without CSM the ERF carries host pinning only and
+    ``--binding-args`` (if given) is passed through verbatim."""
+    import shlex
+    import tempfile
+
+    rankfile = None
+    if getattr(args, "binding_args", None):
+        # User-supplied binding replaces the generated rankfile entirely;
+        # it must still start exactly len(assignments) tasks — the shim
+        # checks its JSM world size against the slot record and fails
+        # fast on a mismatch instead of hanging the collective.
+        binding = shlex.split(args.binding_args)
+    else:
+        fd, rankfile = tempfile.mkstemp(prefix="hvd_tpu_erf_",
+                                        suffix=".txt")
+        with os.fdopen(fd, "w") as f:
+            f.write("overlapping_rs: allow\ncpu_index_using: logical\n")
+            for slot in assignments:
+                f.write(f"rank: {slot.rank}: "
+                        f"{{ hostname: {slot.hostname} }}\n")
+        binding = ["--erf_input", rankfile]
+    env = dict(base_env)
+    env.update({
+        _config.HOROVOD_RENDEZVOUS_ADDR: addr,
+        _config.HOROVOD_RENDEZVOUS_PORT: str(port),
+        "HVD_TPU_COORDINATOR": coordinator,
+    })
+    if args.output_filename:
+        # Keep --output-filename's per-rank directory contract (rank.N/
+        # stdout|stderr): the SHIM redirects each task — jsrun's
+        # --stdio_* flags write one interleaved file, a different shape.
+        env["HVD_TPU_OUTPUT_DIR"] = args.output_filename
+    cmd = (["jsrun"] + binding
+           + [sys.executable, "-m", "horovod_tpu.runner.jsrun_shim"]
+           + args.command)
+    if args.verbose:
+        print("horovodrun: " + " ".join(shlex.quote(c) for c in cmd),
+              file=sys.stderr)
+    try:
+        return safe_shell_exec.execute(cmd, env=env)
+    finally:
+        if rankfile is not None:
+            try:
+                os.remove(rankfile)
+            except OSError:
+                pass
 
 
 def _run_elastic(args) -> int:

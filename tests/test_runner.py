@@ -119,16 +119,26 @@ def test_check_build_prints_matrix(capsys):
     assert "[ ] NCCL" in out
 
 
-def test_jsrun_flag_errors_with_migration_pointer(capsys):
-    """LSF/jsrun launch (reference runner/js_run.py:32) is out of scope by
-    design; the launcher must fail loudly with the migration pointer, not
-    silently fall back to ssh."""
+def test_jsrun_flag_outside_lsf_errors_with_pointer(capsys, monkeypatch):
+    """--jsrun outside an LSF allocation must fail loudly with the
+    migration pointer, not silently fall back to ssh (reference
+    launch.py:764 requires LSF for jsrun)."""
+    monkeypatch.delenv("LSB_JOBID", raising=False)
     with pytest.raises(SystemExit) as ei:
         parse_args(["-np", "1", "--jsrun", "python", "x.py"])
     assert ei.value.code == 2
     err = capsys.readouterr().err
-    assert "jsrun/LSF launch is not supported" in err
+    assert "requires an LSF allocation" in err
     assert "docs/migration.md" in err
+
+
+def test_jsrun_flag_inside_lsf_without_jsrun_errors(capsys, monkeypatch):
+    monkeypatch.setenv("LSB_JOBID", "1234")
+    monkeypatch.setenv("PATH", "/nonexistent")  # no jsrun executable
+    with pytest.raises(SystemExit) as ei:
+        parse_args(["-np", "1", "--jsrun", "python", "x.py"])
+    assert ei.value.code == 2
+    assert "jsrun executable is not on PATH" in capsys.readouterr().err
 
 
 # -- host assignment (hosts.py:100) -----------------------------------------
@@ -660,3 +670,105 @@ def test_discover_common_address_missing_probe_times_out():
                                     ["127.0.0.1"], 1, timeout=1.0)
     finally:
         kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# LSF / jsrun launch path (reference runner/js_run.py:34 + util/lsf.py:35)
+# ---------------------------------------------------------------------------
+
+def test_lsf_host_discovery(monkeypatch, tmp_path):
+    from horovod_tpu.runner import lsf
+    monkeypatch.setenv("LSB_JOBID", "77")
+    hf = tmp_path / "hostfile"
+    hf.write_text("nodeA\nnodeA\nnodeB\n")
+    monkeypatch.setenv("LSB_DJOB_HOSTFILE", str(hf))
+    hosts = lsf.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("nodeA", 2), ("nodeB", 1)]
+    # Fallback: LSB_MCPU_HOSTS pairs.
+    monkeypatch.delenv("LSB_DJOB_HOSTFILE")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 2")
+    hosts = lsf.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("nodeA", 4), ("nodeB", 2)]
+    monkeypatch.delenv("LSB_JOBID")
+    with pytest.raises(RuntimeError, match="LSB_JOBID"):
+        lsf.lsf_hosts()
+
+
+_FAKE_JSRUN = """#!/bin/bash
+# Minimal jsrun: read the ERF rankfile, start one local task per rank with
+# the JSM namespace env, propagate the worst exit code (what jsrun does).
+ERF=""
+ARGS=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --erf_input) ERF="$2"; shift 2 ;;
+    --stdio_stdout|--stdio_stderr) shift 2 ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+N=$(grep -c '^rank:' "$ERF")
+pids=()
+for ((i=0; i<N; i++)); do
+  JSM_NAMESPACE_RANK=$i JSM_NAMESPACE_SIZE=$N "${ARGS[@]}" &
+  pids+=($!)
+done
+rc=0
+for p in "${pids[@]}"; do wait "$p" || rc=1; done
+exit $rc
+"""
+
+
+def test_jsrun_launch_end_to_end(monkeypatch, tmp_path):
+    """--jsrun inside a (mocked) LSF allocation: hosts come from LSF env,
+    ONE jsrun invocation covers both ranks, the shim maps JSM ranks onto
+    the rendezvous slot records, and a REAL 2-rank collective runs."""
+    import stat
+    jsrun = tmp_path / "jsrun"
+    jsrun.write_text(_FAKE_JSRUN)
+    jsrun.chmod(jsrun.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.setenv("LSB_JOBID", "4242")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "localhost 2")
+
+    worker = tmp_path / "worker.py"
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker.write_text(textwrap.dedent(f"""
+        import os, sys
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=1")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {REPO!r})
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        hvd.init()
+        v = hvd.allreduce(jnp.ones(2) * (hvd.rank() + 1), op=hvd.Sum)
+        with open(os.path.join({str(out_dir)!r},
+                               f"rank{{hvd.rank()}}.txt"), "w") as f:
+            f.write(f"{{hvd.rank()}}/{{hvd.size()}}:{{float(v[0])}}")
+    """))
+    from horovod_tpu.runner import launch as launch_mod
+    args = launch_mod.parse_args(
+        ["--jsrun", sys.executable, str(worker)])
+    assert launch_mod._run_static(args) == 0
+    got = sorted((out_dir / f"rank{r}.txt").read_text() for r in (0, 1))
+    assert got == ["0/2:3.0", "1/2:3.0"]
+
+
+def test_jsrun_rejects_elastic_flags(monkeypatch, tmp_path, capsys):
+    """--jsrun + elastic must error loudly: the elastic driver respawns
+    workers over ssh and would silently ignore jsrun."""
+    import stat
+    jsrun = tmp_path / "jsrun"
+    jsrun.write_text("#!/bin/bash\nexit 0\n")
+    jsrun.chmod(jsrun.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.setenv("LSB_JOBID", "1")
+    with pytest.raises(SystemExit) as ei:
+        parse_args(["--jsrun", "--min-np", "2", "--max-np", "4",
+                    "python", "x.py"])
+    assert ei.value.code == 2
+    assert "cannot be combined with elastic flags" in capsys.readouterr().err
