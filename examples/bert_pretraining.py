@@ -34,8 +34,8 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.models import (BERT_LARGE, Transformer, TransformerConfig,
-                                lm_loss)
+from horovod_tpu.models import (BERT_BASE, BERT_LARGE, Transformer,
+                                TransformerConfig, lm_loss)
 
 TINY = TransformerConfig(vocab_size=1024, num_layers=2, num_heads=8,
                          d_model=128, d_ff=256, max_len=128, causal=False,
@@ -96,12 +96,23 @@ def main(argv=None):
     nslots = hvd.num_slots()
     attn = args.attention
     if attn == "auto":
-        attn = "flash" if jax.default_backend() == "tpu" else "dense"
+        # flash only when the kernels actually COMPILE here, for THIS
+        # model's shape/dtype (a Mosaic rejection must degrade to dense,
+        # not kill the bench run — parallel/flash.py flash_supported).
+        from horovod_tpu.parallel.flash import flash_supported
+        probe_cfg = TINY if args.size == "tiny" else \
+            {"base": BERT_BASE, "large": BERT_LARGE}[args.size]
+        attn = "flash" if (
+            jax.default_backend() == "tpu"
+            and flash_supported(
+                dtype=str(jnp.dtype(probe_cfg.dtype)),
+                head_dim=probe_cfg.d_model // probe_cfg.num_heads,
+                seq_len=args.seq_len, causal=probe_cfg.causal)
+        ) else "dense"
     attn_impl = "flash" if attn == "flash" else None
     if args.size == "tiny":
         cfg = dataclasses.replace(TINY, attention_impl=attn_impl)
     else:
-        from horovod_tpu.models import BERT_BASE
         cfg = {"base": BERT_BASE, "large": BERT_LARGE}[args.size]
         cfg = dataclasses.replace(
             cfg, max_len=args.seq_len, remat=args.remat,

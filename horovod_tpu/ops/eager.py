@@ -376,6 +376,20 @@ class EagerEngine:
             else:
                 src = live[0]
             rec = neg.poll_dispatch(src, neg.dispatch_seq + 1)
+            if rec is not None and live:
+                # Stale-snapshot guard: ``joined`` was read BEFORE the
+                # poll, so ``src`` may have joined meanwhile and this
+                # record may be its first NEXT-round dispatch — replaying
+                # it would zero a live rank's contribution one round later
+                # (observed as a wrong sum under full-suite load).  The
+                # join marker is published synchronously before any
+                # next-round record can reach the stream (announce_join is
+                # a direct put; records ride the batched flusher), so a
+                # fresh marker read is authoritative: past its seq, stop —
+                # the all-joined drain branch caps the replay at target.
+                m = neg.join_marker(round_, src)
+                if m is not None and rec["seq"] > m["seq"]:
+                    continue
             if rec is not None:
                 self._replay_record(rec)
                 # The replay published; neg.dispatch_seq advanced by one.

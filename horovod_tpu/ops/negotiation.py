@@ -454,10 +454,16 @@ class Negotiator:
         given join round."""
         out = {}
         for r in range(self.size):
-            raw = self.client.get(f"join{round_}@{self._gen}", str(r))
-            if raw is not None:
-                out[r] = json.loads(raw)
+            m = self.join_marker(round_, r)
+            if m is not None:
+                out[r] = m
         return out
+
+    @_kv_guarded
+    def join_marker(self, round_: int, rank: int) -> Optional[dict]:
+        """One rank's join marker for the round (fresh read), or None."""
+        raw = self.client.get(f"join{round_}@{self._gen}", str(rank))
+        return None if raw is None else json.loads(raw)
 
     @_kv_guarded
     def announce_join(self, round_: int) -> None:

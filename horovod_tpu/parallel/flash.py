@@ -300,6 +300,45 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.lru_cache(maxsize=None)
+def flash_supported(dtype: str = "bfloat16", head_dim: int = 64,
+                    seq_len: int = 256, causal: bool = True) -> bool:
+    """Whether the Pallas kernels COMPILE on the current default backend
+    for THIS configuration (Mosaic tiling/masking differs per shape,
+    dtype, and causality — a verdict for one instantiation says nothing
+    about another, so callers pass the config they are about to run).
+
+    The kernels are numerics-validated in interpret mode, but Mosaic (the
+    TPU kernel compiler) can still reject a construct only at compile
+    time — and a rejection inside a fused train step kills the whole
+    program.  Automatic backend selection (examples/bert_pretraining
+    ``--attention auto``, i.e. the bench battery) probes this first: a
+    tiny fwd+bwd AOT compile of the gated config decides (seconds, and
+    the persistent compile cache makes repeats free), with dense
+    attention as the fallback.  Off-TPU the interpret path is used,
+    which always works."""
+    if pltpu is None:
+        return False
+    if jax.default_backend() != "tpu":
+        return True
+    try:
+        q = jnp.zeros((1, seq_len, 1, head_dim), jnp.dtype(dtype))
+
+        def f(x):
+            return flash_attention(x, x, x, causal=causal).sum()
+
+        jax.jit(jax.grad(f)).lower(q).compile()
+        return True
+    except Exception as e:
+        from ..utils import get_logger
+        get_logger().warning(
+            "Pallas flash attention (dtype=%s head_dim=%d seq=%d "
+            "causal=%s) does not compile on this backend (%s: %s); auto "
+            "attention selection falls back to dense",
+            dtype, head_dim, seq_len, causal, type(e).__name__, e)
+        return False
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     *,
                     causal: bool = False,

@@ -142,3 +142,22 @@ def test_transformer_flash_training_step(hvd8):
     flat = jax.tree_util.tree_leaves(g)
     assert all(np.isfinite(np.asarray(x)).all() for x in flat)
     assert any(float(jnp.max(jnp.abs(x))) > 0 for x in flat)
+
+
+def test_flash_supported_probe(monkeypatch):
+    """auto attention selection must degrade to dense when the kernels
+    don't compile on the claimed backend — here the probe really attempts
+    a TPU lowering on a box with no TPU compiler, which is exactly the
+    Mosaic-rejection shape the fallback exists for."""
+    from horovod_tpu.parallel import flash as F
+    try:
+        F.flash_supported.cache_clear()
+        # CPU: interpret path always works
+        assert F.flash_supported() is True
+        F.flash_supported.cache_clear()
+        monkeypatch.setattr(F.jax, "default_backend", lambda: "tpu")
+        # compile fails -> dense fallback
+        assert F.flash_supported() is False
+    finally:
+        # Never leave a verdict computed under the faked backend cached.
+        F.flash_supported.cache_clear()
