@@ -114,9 +114,11 @@ def main(argv=None):
         cfg = dataclasses.replace(TINY, attention_impl=attn_impl)
     else:
         cfg = {"base": BERT_BASE, "large": BERT_LARGE}[args.size]
+        # scan_layers: ~num_layers x faster compile at identical numerics
+        # (BERT-large's ~7 min remote compile was the bench-window risk).
         cfg = dataclasses.replace(
             cfg, max_len=args.seq_len, remat=args.remat,
-            attention_impl=attn_impl)
+            attention_impl=attn_impl, scan_layers=True)
     model = Transformer(cfg)
     batch = args.batch_per_slot * nslots
     seq_len = min(args.seq_len, cfg.max_len)

@@ -53,8 +53,13 @@ def main(argv=None):
 
     hvd.init()
     nslots = hvd.num_slots()
+    # scan_layers=False deliberately: Adasum's orthogonal-projection
+    # coefficients are PER TENSOR (adasum.h:396-409 semantics), so the
+    # per-layer leaves of the unrolled layout are the reference-faithful
+    # adaptation granularity — a scanned model's stacked [L, ...] leaves
+    # would compute one joint coefficient across all layers.
     model = Transformer(TINY) if args.size == "tiny" else \
-        create_gpt2(args.size, remat=True)
+        create_gpt2(args.size, remat=True, scan_layers=False)
     cfg = model.cfg
     batch = args.batch_per_slot * nslots
     seq_len = min(args.seq_len, cfg.max_len)

@@ -12,6 +12,7 @@ from .resnet import (  # noqa: F401
 
 from .transformer import (  # noqa: F401
     Transformer, TransformerConfig, create_gpt2, create_bert, lm_loss,
+    stack_block_params, unstack_block_params,
     GPT2_SMALL, GPT2_MEDIUM, GPT2_LARGE, BERT_BASE, BERT_LARGE,
 )
 

@@ -403,3 +403,93 @@ def test_sync_batch_stats_arbitrary_reduction_axes(hvd8):
     assert m.shape == (6, 3)
     np.testing.assert_allclose(m, x.mean(axis=(0, 1)), atol=1e-5)
     np.testing.assert_allclose(v, x.var(axis=(0, 1)), atol=1e-5)
+
+
+# -- scan_layers (lax.scan over blocks: ~L x faster compile) -----------------
+
+def test_scan_layers_matches_unrolled(hvd8):
+    """Identical numerics, fwd and grad, with params migrated by
+    stack_block_params; unstack round-trips."""
+    from horovod_tpu.models import (stack_block_params,
+                                    unstack_block_params)
+    cfg_s = dataclasses.replace(TINY, scan_layers=True)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+    m_u, m_s = Transformer(TINY), Transformer(cfg_s)
+    p_u = m_u.init(jax.random.PRNGKey(0), toks)
+    p_s = {"params": stack_block_params(p_u["params"], TINY.num_layers)}
+    np.testing.assert_allclose(m_u.apply(p_u, toks), m_s.apply(p_s, toks),
+                               atol=2e-5)
+
+    def loss(m):
+        return lambda p: lm_loss(m.apply(p, toks)[:, :-1], toks[:, 1:])
+
+    gu = jax.grad(loss(m_u))(p_u)
+    gs = jax.grad(loss(m_s))(p_s)
+    gs_unrolled = unstack_block_params(gs["params"])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=3e-5),
+                 jax.tree.map(np.asarray, gu["params"]),
+                 jax.tree.map(np.asarray, gs_unrolled))
+    # Round-trip of the migration itself.
+    rt = unstack_block_params(p_s["params"])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b),
+                 jax.tree.map(np.asarray, p_u["params"]),
+                 jax.tree.map(np.asarray, rt))
+
+
+def test_scan_layers_shrinks_program(hvd8):
+    """The compile-time claim's proxy: the lowered program must carry ONE
+    block body, not num_layers copies (24-layer measurement: 59.7->5.2 s
+    CPU compile; sizes are the deterministic pin)."""
+    cfg = dataclasses.replace(TINY, num_layers=8)
+    cfg_s = dataclasses.replace(cfg, scan_layers=True)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+
+    def lowered_size(c):
+        m = Transformer(c)
+        v = m.init(jax.random.PRNGKey(0), toks)
+        f = jax.grad(lambda p: lm_loss(m.apply(p, toks)[:, :-1],
+                                       toks[:, 1:]))
+        return len(jax.jit(f).lower(v).as_text())
+
+    assert lowered_size(cfg_s) < lowered_size(cfg) / 2
+
+
+def test_scan_layers_remat_matches(hvd8):
+    cfg_s = dataclasses.replace(TINY, scan_layers=True)
+    cfg_sr = dataclasses.replace(TINY, scan_layers=True, remat=True)
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, 128, (1, 16)))
+    params = Transformer(cfg_s).init(jax.random.PRNGKey(0), toks)
+    a = Transformer(cfg_s).apply(params, toks)
+    b = Transformer(cfg_sr).apply(params, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_scan_layers_seq_parallel_matches_dense(hvd8):
+    """scan over blocks containing ring-attention collectives (ppermute
+    inside the scan body under shard_map) must still match dense."""
+    cfg_sp = dataclasses.replace(TINY, scan_layers=True,
+                                 seq_parallel="ring")
+    model_d = Transformer(TINY)
+    model_s = Transformer(cfg_sp)
+    toks = jnp.asarray(np.random.RandomState(3).randint(0, 128, (2, 64)))
+    from horovod_tpu.models import stack_block_params
+    p_u = model_d.init(jax.random.PRNGKey(0), toks)
+    p_s = {"params": stack_block_params(p_u["params"], TINY.num_layers)}
+    dense_logits = model_d.apply(p_u, toks)
+    positions = jnp.arange(64)[None, :].repeat(2, axis=0)
+    sp_logits = jax.jit(jax.shard_map(
+        lambda t, pos: model_s.apply(p_s, t, positions=pos),
+        mesh=hvd8.mesh(),
+        in_specs=(P(None, "hvd"), P(None, "hvd")),
+        out_specs=P(None, "hvd")))(toks, positions)
+    np.testing.assert_allclose(np.asarray(sp_logits),
+                               np.asarray(dense_logits),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_scan_layers_rejects_interleaved_moe(hvd8):
+    cfg = dataclasses.replace(TINY, scan_layers=True, moe_experts=4,
+                              moe_every=2)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 128, (1, 16)))
+    with pytest.raises(ValueError, match="homogeneous"):
+        Transformer(cfg).init(jax.random.PRNGKey(0), toks)
