@@ -38,11 +38,11 @@ BATCH_PER_CHIP = 128
 WARMUP = 5
 ITERS = 30
 BASELINE_IMG_S_PER_DEV = 1656.82 / 16  # docs/benchmarks.rst:40-42
-# Single source of truth for BERT knob defaults: read by bench_bert AND by
-# _last_good_path's keying (a divergent copy would let an ablation run
-# clobber the driver's default fallback record).
-BERT_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
-                 "BENCH_BERT_MLMPOS": "20"}
+# Single source of truth for model-bench knob defaults: read by
+# bench_bert/bench_gpt2 AND by _last_good_path's keying (a divergent copy
+# would let an ablation run clobber the driver's default fallback record).
+KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
+                 "BENCH_BERT_MLMPOS": "20", "BENCH_GPT2_BATCH": "8"}
 
 
 def _last_good_path():
@@ -55,7 +55,7 @@ def _last_good_path():
         parts.append(model.replace("/", "_"))
     if os.environ.get("BENCH_FAST_STEM", "1") != "1":
         parts.append("naivestem")
-    for var, default in BERT_DEFAULTS.items():
+    for var, default in KNOB_DEFAULTS.items():
         v = os.environ.get(var, default)
         if v != default:
             parts.append(var.rsplit("_", 1)[1].lower() + v)
@@ -132,6 +132,32 @@ from jax.sharding import PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.models import create_resnet50
 
+def bench_gpt2():
+    """BENCH_MODEL=gpt2-medium (BASELINE config 4: GPT-2 medium with
+    Adasum): samples/sec over the same one-JSON-line contract.  Viable on
+    the relay since round 5: scan_layers cut the 24-layer compile ~12x
+    (the >10 min remote compile that blocked rounds 2-4), and per-slice
+    Adasum keeps the reference's per-layer coefficient granularity
+    through the stacked layout (examples/gpt2_adasum.py)."""
+    import contextlib
+    from examples.gpt2_adasum import main as gpt2_main
+    model = os.environ.get("BENCH_MODEL", "gpt2-medium")
+    size = model.split("-", 1)[1] if "-" in model else "medium"
+    bs = os.environ.get("BENCH_GPT2_BATCH",
+                        KNOB_DEFAULTS["BENCH_GPT2_BATCH"])
+    argv = ["--size", size, "--steps", "10", "--batch-per-slot", bs,
+            "--seq-len", "128"]
+    with contextlib.redirect_stdout(sys.stderr):  # keep stdout = 1 JSON line
+        losses, samples_s = gpt2_main(argv)
+    _emit({
+        "metric": f"gpt2_{size}_adasum_samples_per_sec",
+        "value": round(samples_s, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_s / hvd.num_slots(), 3),
+        "config": f"bs{bs}/slot seq128 adasum(per-layer) remat scan-layers",
+    })
+
+
 def bench_bert():
     """BENCH_MODEL=bert-large: BERT-large MLM samples/sec (BASELINE config 3).
     Keeps the same one-JSON-line contract; the reference publishes no BERT
@@ -139,11 +165,11 @@ def bench_bert():
     import contextlib
     from examples.bert_pretraining import main as bert_main
     bs = os.environ.get("BENCH_BERT_BATCH",
-                        BERT_DEFAULTS["BENCH_BERT_BATCH"])
+                        KNOB_DEFAULTS["BENCH_BERT_BATCH"])
     attn = os.environ.get("BENCH_BERT_ATTN",
-                          BERT_DEFAULTS["BENCH_BERT_ATTN"])
+                          KNOB_DEFAULTS["BENCH_BERT_ATTN"])
     mlm_pos = os.environ.get("BENCH_BERT_MLMPOS",
-                             BERT_DEFAULTS["BENCH_BERT_MLMPOS"])
+                             KNOB_DEFAULTS["BENCH_BERT_MLMPOS"])
     argv = ["--size", "large", "--steps", "10", "--batch-per-slot", bs,
             "--seq-len", "128", "--attention", attn,
             "--mlm-positions", mlm_pos]
@@ -213,6 +239,10 @@ def main():
     if os.environ.get("BENCH_MODEL", "").startswith("bert"):
         hvd.init()
         bench_bert()
+        return
+    if os.environ.get("BENCH_MODEL", "").startswith("gpt2"):
+        hvd.init()
+        bench_gpt2()
         return
     hvd.init()
     nslots = hvd.num_slots()

@@ -53,13 +53,14 @@ def main(argv=None):
 
     hvd.init()
     nslots = hvd.num_slots()
-    # scan_layers=False deliberately: Adasum's orthogonal-projection
-    # coefficients are PER TENSOR (adasum.h:396-409 semantics), so the
-    # per-layer leaves of the unrolled layout are the reference-faithful
-    # adaptation granularity — a scanned model's stacked [L, ...] leaves
-    # would compute one joint coefficient across all layers.
+    # scan_layers (factory default): ~num_layers x faster compile — the
+    # >10 min remote-compile that blocked on-chip GPT-2 captures in rounds
+    # 2-4.  Adasum's per-tensor coefficient granularity (adasum.h:396-409)
+    # survives the stacked [L, ...] layout via per_layer_stacked below:
+    # the scanned blocks get one coefficient pair PER LAYER SLICE, exactly
+    # what the unrolled layout computed.
     model = Transformer(TINY) if args.size == "tiny" else \
-        create_gpt2(args.size, remat=True, scan_layers=False)
+        create_gpt2(args.size, remat=True)
     cfg = model.cfg
     batch = args.batch_per_slot * nslots
     seq_len = min(args.seq_len, cfg.max_len)
@@ -75,6 +76,11 @@ def main(argv=None):
     opt = optax.sgd(0.05)
     opt_state = opt.init(params)
 
+    def _stacked_layer_leaf(path):
+        # The scanned model's "blocks" subtree stacks per-layer params on
+        # axis 0; per-slice Adasum keeps reference granularity there.
+        return any(getattr(p, "key", None) == "blocks" for p in path)
+
     def local_step(params, opt_state, toks):
         def loss_fn(p):
             logits = model.apply(p, toks)
@@ -82,7 +88,9 @@ def main(argv=None):
         # LOCAL grads: Adasum adapts from per-rank gradient divergence.
         loss, grads = hvd.local_value_and_grad(loss_fn)(params)
         new_params, opt_state2 = hvd.adasum_delta_step(
-            opt, params, grads, opt_state)
+            opt, params, grads, opt_state,
+            per_layer_stacked=_stacked_layer_leaf if cfg.scan_layers
+            else None)
         return new_params, opt_state2, hvd.allreduce(loss, op=hvd.Average)
 
     step = hvd.parallel.shard_step(

@@ -326,10 +326,12 @@ def create_gpt2(size: str = "medium", **overrides) -> Transformer:
     943 -> 137 kB) — the fix for GPT-2-medium's >10 min remote compile.
     Pass ``scan_layers=False`` for the unrolled block_i param layout;
     ``stack_block_params``/``unstack_block_params`` convert checkpoints.
-    Caveat: per-TENSOR gradient methods change granularity over stacked
-    leaves — Adasum in particular computes its projection coefficients
-    per leaf, so Adasum training should keep ``scan_layers=False``
-    (examples/gpt2_adasum.py does)."""
+    Caveat: per-TENSOR gradient methods see stacked leaves as one tensor —
+    Adasum in particular computes its projection coefficients per leaf.
+    Keep the reference's per-layer granularity by passing
+    ``per_layer_stacked`` to ``hvd.adasum_delta_step`` (it computes one
+    coefficient pair per layer slice; examples/gpt2_adasum.py shows the
+    pattern), or fall back to ``scan_layers=False``."""
     base = {"small": GPT2_SMALL, "medium": GPT2_MEDIUM,
             "large": GPT2_LARGE}[size]
     overrides.setdefault("scan_layers", True)

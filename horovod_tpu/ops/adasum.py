@@ -41,26 +41,43 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _coefficients(a32: jax.Array, b32: jax.Array):
-    """acoeff/bcoeff per adasum.h:396-409, guarded for zero norms."""
-    dot = jnp.sum(a32 * b32)
-    na = jnp.sum(a32 * a32)
-    nb = jnp.sum(b32 * b32)
-    acoeff = jnp.where(na > 0, 1.0 - dot / jnp.where(na > 0, 2.0 * na, 1.0), 1.0)
-    bcoeff = jnp.where(nb > 0, 1.0 - dot / jnp.where(nb > 0, 2.0 * nb, 1.0), 1.0)
+def _coefficients(a32: jax.Array, b32: jax.Array,
+                  per_slice_axis0: bool = False):
+    """acoeff/bcoeff per adasum.h:396-409, guarded for zero norms.
+
+    ``per_slice_axis0``: compute INDEPENDENT coefficients per leading-axis
+    slice (dots/norms reduce over every other axis).  This is how a
+    ``scan_layers`` model's stacked [L, ...] parameter leaves keep the
+    reference's per-tensor adaptation granularity — one coefficient pair
+    per layer, not one joint pair across the whole stack."""
+    axes = tuple(range(1, a32.ndim)) if per_slice_axis0 else None
+    dot = jnp.sum(a32 * b32, axis=axes)
+    na = jnp.sum(a32 * a32, axis=axes)
+    nb = jnp.sum(b32 * b32, axis=axes)
+    acoeff = jnp.where(na > 0, 1.0 - dot / jnp.where(na > 0, 2.0 * na, 1.0),
+                       1.0)
+    bcoeff = jnp.where(nb > 0, 1.0 - dot / jnp.where(nb > 0, 2.0 * nb, 1.0),
+                       1.0)
+    if per_slice_axis0:
+        shape = (a32.shape[0],) + (1,) * (a32.ndim - 1)
+        acoeff = acoeff.reshape(shape)
+        bcoeff = bcoeff.reshape(shape)
     return acoeff, bcoeff
 
 
-def pair_combine(a: jax.Array, b: jax.Array) -> jax.Array:
+def pair_combine(a: jax.Array, b: jax.Array,
+                 per_slice_axis0: bool = False) -> jax.Array:
     """Adasum of one pair, f32 accumulation island."""
     a32 = a.astype(jnp.float32)
     b32 = b.astype(jnp.float32)
-    acoeff, bcoeff = _coefficients(a32, b32)
+    acoeff, bcoeff = _coefficients(a32, b32, per_slice_axis0)
     return (acoeff * a32 + bcoeff * b32).astype(a.dtype)
 
 
-def _tree_reduce_gathered(stacked: jax.Array) -> jax.Array:
+def _tree_reduce_gathered(stacked: jax.Array,
+                          per_slice_axis0: bool = False) -> jax.Array:
     """Binary-tree Adasum over a [n, ...] stack (non-pow2 fallback)."""
+    import functools
     n = stacked.shape[0]
     pow2 = 1
     while pow2 < n:
@@ -68,20 +85,24 @@ def _tree_reduce_gathered(stacked: jax.Array) -> jax.Array:
     if pow2 != n:
         pad = jnp.zeros((pow2 - n,) + stacked.shape[1:], dtype=stacked.dtype)
         stacked = jnp.concatenate([stacked, pad], axis=0)
+    combine = functools.partial(pair_combine,
+                                per_slice_axis0=per_slice_axis0)
     while stacked.shape[0] > 1:
-        stacked = jax.vmap(pair_combine)(stacked[0::2], stacked[1::2])
+        stacked = jax.vmap(combine)(stacked[0::2], stacked[1::2])
     return stacked[0]
 
 
 def adasum_allreduce(x: jax.Array,
                      *,
                      axis_name: str = "hvd",
-                     members=None) -> jax.Array:
+                     members=None,
+                     per_slice_axis0: bool = False) -> jax.Array:
     """Adasum allreduce over a mesh axis (ReduceOp.ADASUM dispatch target,
     message.h:46; AdasumMPIAllreduceOp analog).
 
     ``members``: optional static subset of slot indices (process set);
-    non-member slots keep their input."""
+    non-member slots keep their input.  ``per_slice_axis0``: independent
+    coefficients per leading-axis slice (see :func:`_coefficients`)."""
     n = lax.axis_size(axis_name) if members is None else len(members)
     if n == 1:
         return x
@@ -99,13 +120,13 @@ def adasum_allreduce(x: jax.Array,
             is_lower = (idx & bit) == 0
             a = jnp.where(is_lower, x, partner)
             b = jnp.where(is_lower, partner, x)
-            x = pair_combine(a, b)
+            x = pair_combine(a, b, per_slice_axis0)
         return x
     stacked = lax.all_gather(x, axis_name, axis=0)
     if members is not None:
         sel = stacked[jnp.asarray(members, dtype=jnp.int32)]
-        r = _tree_reduce_gathered(sel)
+        r = _tree_reduce_gathered(sel, per_slice_axis0)
         idx = lax.axis_index(axis_name)
         mask = jnp.isin(idx, jnp.asarray(members, dtype=jnp.int32))
         return jnp.where(mask, r, x)
-    return _tree_reduce_gathered(stacked)
+    return _tree_reduce_gathered(stacked, per_slice_axis0)

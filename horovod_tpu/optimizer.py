@@ -515,7 +515,8 @@ def local_value_and_grad(fun: Callable, **jax_kwargs):
 
 
 def adasum_delta_step(optimizer, params, grads, opt_state,
-                      process_set: ProcessSet = global_process_set):
+                      process_set: ProcessSet = global_process_set,
+                      per_layer_stacked: Optional[Callable] = None):
     """Adasum on post-optimizer deltas (_DistributedAdasumOptimizer,
     torch/optimizer.py:345): apply the optimizer locally, Adasum-reduce the
     parameter delta, add the reduced delta to the original parameters.
@@ -523,12 +524,45 @@ def adasum_delta_step(optimizer, params, grads, opt_state,
     ``grads`` must be LOCAL per-slot gradients (use ``local_value_and_grad``
     in-trace); Adasum over pre-summed gradients degenerates to identity.
     Under shard_map, run the step with ``shard_step(..., check_vma=False)``:
-    the butterfly's output is equal on every slot but typed varying."""
+    the butterfly's output is equal on every slot but typed varying.
+
+    ``per_layer_stacked(path) -> bool``: leaves for which it returns True
+    are treated as stacked [L, ...] per-layer parameters (a ``scan_layers``
+    model's ``blocks`` subtree) and Adasum computes INDEPENDENT
+    coefficients per layer slice — the reference's per-tensor adaptation
+    granularity, preserved through the stacked layout."""
     local_updates, new_state = optimizer.update(grads, opt_state, params)
-    reduced_updates = jax.tree_util.tree_map(
-        lambda u: _ops.allreduce(u, op=ReduceOp.ADASUM,
-                                 process_set=process_set),
-        local_updates)
+    if per_layer_stacked is None:
+        reduced_updates = jax.tree_util.tree_map(
+            lambda u: _ops.allreduce(u, op=ReduceOp.ADASUM,
+                                     process_set=process_set),
+            local_updates)
+    else:
+        from .ops.adasum import adasum_allreduce as _adasum
+        if not _axis_bound(_axis_name()):
+            # The stacked branch runs the butterfly directly over the
+            # mesh axis; outside shard_map there is none to run over —
+            # and the rest of this function's contract (LOCAL per-slot
+            # grads) is in-trace anyway, so name the requirement instead
+            # of letting lax.axis_size raise a bare NameError.
+            raise ValueError(
+                "adasum_delta_step(per_layer_stacked=...) must run "
+                "in-trace under shard_map (hvd.parallel.shard_step) — "
+                "the per-slice Adasum butterfly needs the bound mesh "
+                "axis")
+
+        def _leaf(path, u):
+            if per_layer_stacked(path):
+                return _adasum(
+                    u, axis_name=_axis_name(),
+                    members=None if process_set is global_process_set
+                    else process_set.members(),
+                    per_slice_axis0=True)
+            return _ops.allreduce(u, op=ReduceOp.ADASUM,
+                                  process_set=process_set)
+
+        reduced_updates = jax.tree_util.tree_map_with_path(
+            _leaf, local_updates)
     # Stateful optimizers (adam moments etc.) updated their state from LOCAL
     # gradients, so it diverges per rank; average it back to consistency —
     # without this, returning the state through replicated out_specs would

@@ -112,3 +112,56 @@ def test_adasum_eager(hvd8):
     out = hvd8.allreduce(stacked, op=hvd.Adasum)
     expected = np_adasum_tree(list(np.asarray(stacked)))
     np.testing.assert_allclose(np.asarray(out[0]), expected, rtol=1e-4)
+
+
+def test_per_slice_adasum_equals_per_layer_adasum(hvd8):
+    """per_slice_axis0 over a stacked [L, ...] leaf must equal running the
+    plain butterfly on each layer slice independently — the contract that
+    lets scan_layers models keep the reference's per-tensor Adasum
+    granularity (adasum.h:396-409) through the stacked layout."""
+    L, D = 3, 16
+    rng = np.random.RandomState(0)
+    # Different per-layer scales so joint vs per-layer coefficients differ.
+    per_rank = (rng.randn(N, L, D) *
+                np.array([1, 10, 100])[None, :, None]).astype(np.float32)
+    x = jnp.asarray(per_rank)  # [N, L, D]: stacked over ranks
+
+    per_slice = np.asarray(run_spmd(
+        hvd8, lambda s: A.adasum_allreduce(s, per_slice_axis0=True), x))
+    for layer in range(L):
+        per_layer = np.asarray(run_spmd(
+            hvd8, lambda s: A.adasum_allreduce(s),
+            jnp.asarray(per_rank[:, layer])))
+        np.testing.assert_allclose(per_slice[0, layer], per_layer[0],
+                                   rtol=1e-5, atol=1e-4)
+    # And per-slice must DIFFER from the joint-coefficient result (the
+    # granularity bug it prevents).
+    joint = np.asarray(run_spmd(
+        hvd8, lambda s: A.adasum_allreduce(s), x))
+    assert not np.allclose(joint, per_slice)
+
+
+def test_per_slice_adasum_subset_members(hvd8):
+    """per_slice plumbing through the gathered fallback: a 3-member (non
+    power-of-two) process-set Adasum over a stacked leaf must match the
+    per-layer NumPy tree model; non-members keep their input."""
+    L, D = 2, 8
+    members = [1, 4, 6]
+    rng = np.random.RandomState(1)
+    per_rank = (rng.randn(N, L, D) *
+                np.array([1, 50])[None, :, None]).astype(np.float32)
+
+    out = np.asarray(run_spmd(
+        hvd8,
+        lambda s: A.adasum_allreduce(s, members=members,
+                                     per_slice_axis0=True),
+        jnp.asarray(per_rank)))
+    for layer in range(L):
+        expect = np_adasum_tree([per_rank[m, layer] for m in members] +
+                                [np.zeros((D,), np.float64)])
+        for m in members:
+            np.testing.assert_allclose(out[m, layer], expect,
+                                       rtol=1e-4, atol=1e-4)
+    for r in range(N):
+        if r not in members:
+            np.testing.assert_allclose(out[r], per_rank[r], atol=1e-6)

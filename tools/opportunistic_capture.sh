@@ -74,7 +74,13 @@ EOF
             BENCH_BERT_ATTN=flash python bench.py \
             >> artifacts/capture_bert_flash.log 2>&1
         rc4=$?
-        echo "--- battery done rc=($rc1,$rc2,$rc3,$rc4) $(date -u +%FT%TZ) ---" >> "$LOG"
+        # 5. GPT-2 medium + per-layer Adasum (BASELINE config 4; viable
+        # since scan_layers cut its compile ~12x)
+        timeout 1800 env BENCH_PROBE_BUDGET_S=120 BENCH_MODEL=gpt2-medium \
+            python bench.py \
+            >> artifacts/capture_gpt2.log 2>&1
+        rc5=$?
+        echo "--- battery done rc=($rc1,$rc2,$rc3,$rc4,$rc5) $(date -u +%FT%TZ) ---" >> "$LOG"
         if [ "$rc1" -eq 0 ]; then
             echo "=== capture complete; watcher exiting ===" >> "$LOG"
             exit 0
