@@ -58,7 +58,11 @@ def _last_good_path():
     for var, default in KNOB_DEFAULTS.items():
         v = os.environ.get(var, default)
         if v != default:
-            parts.append(var.rsplit("_", 1)[1].lower() + v)
+            # Unambiguous per-knob suffix ("bertbatch16"/"gpt2batch16"):
+            # a bare "batch16" would collide across models and let one
+            # model's ablation serve as another's stale floor.
+            parts.append(var.replace("BENCH_", "").replace("_", "")
+                         .lower() + v)
     tag = os.environ.get("HVD_TPU_BENCH_TAG", "")
     if tag:
         parts.append(tag)

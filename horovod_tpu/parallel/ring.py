@@ -83,7 +83,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "hvd",
                    causal: bool = False,
                    scale: Optional[float] = None,
-                   striped: bool = False) -> jax.Array:
+                   striped: bool = False,
+                   remat_hops: bool = True) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args:
@@ -97,6 +98,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         near-triangular block instead of all-or-nothing, halving wasted
         MXU work on wide rings.  Default False = contiguous blocks (shard i
         holds tokens [i*S_local, (i+1)*S_local)).
+      remat_hops: rematerialize each hop in the backward pass (default).
+        Without it, scan autodiff saves every hop's [Sq, Sk] probability
+        block — O(S_global * S_local) per device, the exact memory wall
+        ring attention exists to avoid; with it, the backward recomputes
+        the block scores from the streamed K/V (the RingAttention
+        recipe's memory bound) at ~one extra forward of FLOPs.
 
     Returns local attention output [B, S_local, H, D] (same sharding as q).
     """
@@ -155,9 +162,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         kv_v = lax.ppermute(kv_v, axis_name, perm)
         return (kv_k, kv_v, acc_new, m_new, l_new), None
 
+    body = jax.checkpoint(round_fn) if remat_hops else round_fn
     init = (k.astype(jnp.float32), v.astype(jnp.float32), acc, m, l)
     (kv_k, kv_v, acc, m, l), _ = lax.scan(
-        round_fn, init, jnp.arange(n, dtype=jnp.int32))
+        body, init, jnp.arange(n, dtype=jnp.int32))
 
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)

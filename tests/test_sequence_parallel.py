@@ -150,3 +150,33 @@ def test_striped_positions(hvd8):
     arr = np.asarray(pos)  # [8, 4]
     np.testing.assert_array_equal(arr[0], [0, 8, 16, 24])
     np.testing.assert_array_equal(arr[3], [3, 11, 19, 27])
+
+
+def test_ring_attention_remat_hops_parity_and_memory(hvd8):
+    """remat_hops (default) must not change gradients, and must shrink the
+    backward's temp memory: without it, scan autodiff saves every hop's
+    [Sq, Sk] probability block — the O(S_global x S_local) wall ring
+    attention exists to avoid."""
+    from horovod_tpu.parallel.ring import ring_attention
+    B, S, H, D = 2, 512, 4, 32
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    def make(remat):
+        def f(q, k, v):
+            def loss(q):
+                return jnp.mean(ring_attention(
+                    q, k, v, axis_name="hvd", causal=True,
+                    remat_hops=remat) ** 2)
+            return jax.grad(loss)(q)
+        return jax.jit(jax.shard_map(f, mesh=hvd8.mesh(),
+                                     in_specs=(P(None, "hvd"),) * 3,
+                                     out_specs=P(None, "hvd")))
+
+    f_save, f_remat = make(False), make(True)
+    np.testing.assert_allclose(np.asarray(f_save(q, q, q)),
+                               np.asarray(f_remat(q, q, q)), atol=1e-6)
+    temp = {r: f.lower(q, q, q).compile()
+            .memory_analysis().temp_size_in_bytes
+            for r, f in ((False, f_save), (True, f_remat))}
+    assert temp[True] < temp[False] * 0.75, temp
