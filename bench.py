@@ -55,6 +55,8 @@ def _last_good_path():
         parts.append(model.replace("/", "_"))
     if os.environ.get("BENCH_FAST_STEM", "1") != "1":
         parts.append("naivestem")
+    if os.environ.get("BENCH_SMOKE") == "1":
+        parts.append("smoke")
     for var, default in KNOB_DEFAULTS.items():
         v = os.environ.get(var, default)
         if v != default:
@@ -251,15 +253,24 @@ def main():
     hvd.init()
     nslots = hvd.num_slots()
     fast_stem = os.environ.get("BENCH_FAST_STEM", "1") == "1"
-    model = create_resnet50(num_classes=1000, dtype=jnp.bfloat16,
+    # BENCH_SMOKE=1: tiny shapes/iters so the FULL success path — probe,
+    # train, fresh emit superseding the stale line, persistence — runs
+    # hermetically on CPU in tests (tests/test_bench_fallback.py).  The
+    # record is keyed separately (_last_good_path adds "smoke"), so a
+    # smoke run can never clobber the driver's fallback record.
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    bpc, warmup, iters, hw, ncls = \
+        (4, 1, 2, 64, 10) if smoke else \
+        (BATCH_PER_CHIP, WARMUP, ITERS, 224, 1000)
+    model = create_resnet50(num_classes=ncls, dtype=jnp.bfloat16,
                             sync_bn=True, fast_stem=fast_stem)
     rng = jax.random.PRNGKey(0)
-    batch = BATCH_PER_CHIP * nslots
+    batch = bpc * nslots
 
     images = jnp.asarray(
-        np.random.RandomState(0).rand(batch, 224, 224, 3).astype(np.float32))
+        np.random.RandomState(0).rand(batch, hw, hw, 3).astype(np.float32))
     labels = jnp.asarray(
-        np.random.RandomState(1).randint(0, 1000, size=(batch,)))
+        np.random.RandomState(1).randint(0, ncls, size=(batch,)))
 
     # init outside shard_map: train=False avoids unbound-axis sync-BN stats
     variables = model.init(rng, images[:2], train=False)
@@ -293,7 +304,7 @@ def main():
     # dependency chain through params, so fetching the last loss forces every
     # step to have executed (block_until_ready alone is unreliable through
     # remote-execution PJRT transports).
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
     float(loss)
@@ -302,7 +313,7 @@ def main():
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
     float(loss)
@@ -310,15 +321,16 @@ def main():
     if profile_dir:
         jax.profiler.stop_trace()
 
-    img_s = batch * ITERS / dt
+    img_s = batch * iters / dt
     per_dev = img_s / nslots
     _emit({
         "metric": "resnet50_synthetic_images_per_sec",
         "value": round(img_s, 2),
         "unit": "images/sec",
         "vs_baseline": round(per_dev / BASELINE_IMG_S_PER_DEV, 3),
-        "config": f"bs{BATCH_PER_CHIP}/chip bf16 sync-bn "
-                  f"{'s2d-stem' if fast_stem else 'naive-stem'}",
+        "config": f"bs{bpc}/chip bf16 sync-bn "
+                  f"{'s2d-stem' if fast_stem else 'naive-stem'}"
+                  + (" SMOKE" if smoke else ""),
     })
 
 

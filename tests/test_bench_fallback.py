@@ -36,15 +36,22 @@ _FAKE_RECORD = {
 }
 
 
-def _bench_env(tag):
+def _bench_env(tag, **overrides):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # plugin never registers...
     env["JAX_PLATFORMS"] = "axon"  # ...and this makes devices() raise
     # (not fall back to CPU) even in a shell without the ambient var
-    env.pop("BENCH_MODEL", None)
+    # Sanitize every record-keying / behavior knob an ambient shell could
+    # export — an inherited BENCH_FAST_STEM=0 would silently re-key
+    # _last_good_path away from the records these tests plant.
+    for var in ("BENCH_MODEL", "BENCH_FAST_STEM", "BENCH_SMOKE",
+                "BENCH_PROFILE", "BENCH_BERT_BATCH", "BENCH_BERT_ATTN",
+                "BENCH_BERT_MLMPOS", "BENCH_GPT2_BATCH"):
+        env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
     env["BENCH_PROBE_TIMEOUT_S"] = "5"
+    env.update(overrides)
     return env
 
 
@@ -109,3 +116,38 @@ def test_no_prior_capture_fails_with_clear_message():
     assert r.returncode != 0
     assert not _json_lines(r.stdout)  # nothing to emit — and says so
     assert "no prior capture" in r.stderr
+
+
+def test_fresh_capture_supersedes_stale(tmp_path):
+    """The SUCCESS path, end-to-end on CPU (BENCH_SMOKE shapes): the
+    emit-first stale line prints first, the probe succeeds, a real train
+    runs, and the fresh capture is the LAST stdout JSON line and the
+    persisted record — the driver's happy path, which otherwise only
+    ever executes on the real chip."""
+    tag = "pytestsmoke"
+    path = os.path.join(_REPO, "artifacts", f"last_bench_smoke_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(dict(_FAKE_RECORD, value=99.9), f)
+    env = _bench_env(tag, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+                     BENCH_PROBE_BUDGET_S="60",
+                     BENCH_PROBE_TIMEOUT_S="30")
+    try:
+        r = subprocess.run([sys.executable, _BENCH], env=env,
+                           capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-1500:]
+        records = _json_lines(r.stdout)
+        assert records[0].get("stale") is True     # emit-first floor
+        assert records[0]["value"] == 99.9
+        last = records[-1]
+        assert "stale" not in last                 # superseded by fresh
+        assert last["metric"] == "resnet50_synthetic_images_per_sec"
+        assert "SMOKE" in last["config"]
+        with open(path) as f:
+            persisted = json.load(f)
+        assert persisted["value"] == last["value"]  # persisted for next time
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
