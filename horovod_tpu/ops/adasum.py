@@ -34,8 +34,6 @@ with zero-padded virtual ranks (``adasum(a, 0) = a``), preserving the math.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax

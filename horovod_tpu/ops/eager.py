@@ -35,7 +35,6 @@ C++ ResponseCache covers the negotiation side).
 from __future__ import annotations
 
 import threading
-from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax

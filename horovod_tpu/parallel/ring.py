@@ -29,7 +29,6 @@ inside shard_map with the sequence dimension sharded over ``axis_name``.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 

@@ -20,7 +20,7 @@ import os
 import socket
 import sys
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from .. import config as _config
 from ..version import __version__

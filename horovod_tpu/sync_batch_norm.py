@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .process_sets import ProcessSet, global_process_set
+from .process_sets import ProcessSet
 
 
 def sync_batch_stats(x: jax.Array,
