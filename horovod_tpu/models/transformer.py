@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 import flax.linen as nn
 
-from ..parallel.ring import ring_attention, ring_attention_reference
+from ..parallel.ring import (ring_attention, ring_attention_reference,
+                             ring_flash_attention)
 from ..parallel.ulysses import ulysses_attention
 
 
@@ -90,28 +91,26 @@ class SelfAttention(nn.Module):
             raise ValueError(
                 f"unknown attention_impl {cfg.attention_impl!r}; "
                 f"expected None or 'flash'")
-        if cfg.attention_impl == "flash" and \
-                cfg.seq_parallel in ("ring", "ring_striped"):
-            raise ValueError(
-                "attention_impl='flash' composes with seq_parallel=None or "
-                "'ulysses'; ring attention performs its own blockwise "
-                "online-softmax math and takes no local kernel")
-        local_attn = None
-        if cfg.attention_impl == "flash":
-            from ..parallel.flash import flash_attention
+        use_flash = cfg.attention_impl == "flash"
 
-            def local_attn(q, k, v, *, causal, scale=None):
-                return flash_attention(q, k, v, causal=causal, scale=scale)
+        def local_flash(q, k, v, *, causal, scale=None):
+            from ..parallel.flash import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+
         if cfg.seq_parallel in ("ring", "ring_striped"):
-            out = ring_attention(q, k, v, axis_name=cfg.axis_name,
-                                 causal=cfg.causal,
-                                 striped=cfg.seq_parallel == "ring_striped")
+            # flash composes with the ring since round 5: the per-hop
+            # block math runs in the Pallas kernel and the hops combine
+            # by the (out, lse) logsumexp merge (ring_flash_attention).
+            ring_fn = ring_flash_attention if use_flash else ring_attention
+            out = ring_fn(q, k, v, axis_name=cfg.axis_name,
+                          causal=cfg.causal,
+                          striped=cfg.seq_parallel == "ring_striped")
         elif cfg.seq_parallel == "ulysses":
-            out = ulysses_attention(q, k, v, axis_name=cfg.axis_name,
-                                    causal=cfg.causal,
-                                    attention_fn=local_attn)
-        elif local_attn is not None:
-            out = local_attn(q, k, v, causal=cfg.causal)
+            out = ulysses_attention(
+                q, k, v, axis_name=cfg.axis_name, causal=cfg.causal,
+                attention_fn=local_flash if use_flash else None)
+        elif use_flash:
+            out = local_flash(q, k, v, causal=cfg.causal)
         else:
             out = ring_attention_reference(q, k, v, causal=cfg.causal)
         return dense(features=cfg.d_model, axis=(-2, -1), name="proj")(out)

@@ -48,17 +48,35 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width: (block_q, _LANES) scratch keeps m/l aligned
 
+# Static mask modes (ring attention's per-hop block masks compile one
+# kernel per mode): NONE = full attend; CAUSAL = q >= k on local indices;
+# STRICT = q > k (the striped ring's off-diagonal rule).
+MASK_NONE, MASK_CAUSAL, MASK_STRICT = 0, 1, 2
 
-def _causal_mask(s, qi, kb, block_q, block_k):
+
+def _causal_mask(s, qi, kb, block_q, block_k, mode):
+    if mode == MASK_NONE:
+        return s
     qg = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     kg = kb * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(qg >= kg, s, NEG_INF)
+    keep = qg >= kg if mode == MASK_CAUSAL else qg > kg
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _block_contributes(mode, qi, kb, block_q, block_k):
+    # Blocks entirely outside the mask contribute nothing — skip the MXU
+    # work (their DMA is already in flight; acceptable overfetch).
+    if mode == MASK_NONE:
+        return True
+    if mode == MASK_CAUSAL:
+        return kb * block_k <= qi * block_q + block_q - 1
+    return kb * block_k < qi * block_q + block_q - 1  # STRICT
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
+                scale: float, mask_mode: int, block_q: int, block_k: int,
                 num_kb: int):
     qi, kb = pl.program_id(1), pl.program_id(2)
 
@@ -68,10 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
         m[...] = jnp.full_like(m, NEG_INF)
         l[...] = jnp.zeros_like(l)
 
-    # Causal: blocks entirely above the diagonal contribute nothing — skip
-    # the MXU work (their DMA is already in flight; acceptable overfetch).
-    contributes = True if not causal else \
-        kb * block_k <= qi * block_q + block_q - 1
+    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
 
     @pl.when(contributes)
     def _step():
@@ -81,8 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [Bq, Bk]
-        if causal:
-            s = _causal_mask(s, qi, kb, block_q, block_k)
+        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
         m_prev = m[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -102,7 +116,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale: float, causal: bool, block_q: int,
+                   dq_acc, *, scale: float, mask_mode: int, block_q: int,
                    block_k: int, num_kb: int):
     qi, kb = pl.program_id(1), pl.program_id(2)
 
@@ -110,8 +124,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    contributes = True if not causal else \
-        kb * block_k <= qi * block_q + block_q - 1
+    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
 
     @pl.when(contributes)
     def _step():
@@ -122,8 +135,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, qi, kb, block_q, block_k)
+        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
         p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -140,7 +152,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    causal: bool, block_q: int, block_k: int, num_qb: int):
+                    mask_mode: int, block_q: int, block_k: int,
+                    num_qb: int):
     kb, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -148,8 +161,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    contributes = True if not causal else \
-        qi * block_q + block_q - 1 >= kb * block_k
+    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
 
     @pl.when(contributes)
     def _step():
@@ -160,8 +172,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, qi, kb, block_q, block_k)
+        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
         p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
         dv_acc[...] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -204,15 +215,17 @@ def _require_pltpu():
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash(q, k, v, mask_mode, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, mask_mode, scale, block_q, block_k,
+                        interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask_mode, scale, block_q, block_k, interpret):
     BH, S, D = q.shape
     num_qb, num_kb = S // block_q, S // block_k
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, scale=scale,
+                               mask_mode=mask_mode,
                                block_q=block_q, block_k=block_k,
                                num_kb=num_kb)
     out, lse = pl.pallas_call(
@@ -240,19 +253,27 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(mask_mode, scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    BH, S, D = q.shape
-    num_qb, num_kb = S // block_q, S // block_k
-    do = g
     # delta_i = rowsum(dO_i * O_i) — tiny elementwise pass; let XLA fuse it
     # in f32.  dO itself stays in its original dtype (the kernels upcast
     # per-block in VMEM; a host-side astype would double bf16 DMA traffic).
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                   # [BH, S]
+    return _run_bwd_kernels(q, k, v, g, lse, delta, mask_mode, scale,
+                            block_q, block_k, interpret)
+
+
+def _run_bwd_kernels(q, k, v, do, lse, delta, mask_mode, scale,
+                     block_q, block_k, interpret):
+    """The two FlashAttention-2 backward kernels, shared by the plain and
+    the lse-exposing vjps (the latter folds the lse cotangent into
+    ``delta``; see ``_flash_lse_bwd``)."""
+    BH, S, D = q.shape
+    num_qb, num_kb = S // block_q, S // block_k
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dq_kernel, scale=scale, mask_mode=mask_mode,
                           block_q=block_q, block_k=block_k, num_kb=num_kb),
         out_shape=_out_struct((BH, S, D), q.dtype, q),
         grid=(BH, num_qb, num_kb),
@@ -272,7 +293,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel, scale=scale,
+                          mask_mode=mask_mode,
                           block_q=block_q, block_k=block_k, num_qb=num_qb),
         out_shape=[_out_struct((BH, S, D), k.dtype, k),
                    _out_struct((BH, S, D), v.dtype, v)],
@@ -298,6 +320,44 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, mask_mode, scale, block_q, block_k, interpret,
+               out_dtype):
+    """Like ``_flash`` but returns (out, lse) and is differentiable in
+    BOTH outputs — the building block ring attention's cross-hop
+    logsumexp merge needs (the merge weights are functions of lse, so a
+    nonzero lse cotangent flows back into q/k).  ``out_dtype`` lets the
+    merge receive f32 partials (one quantization at the END of the ring,
+    not one per hop)."""
+    (out, lse), _ = _flash_lse_fwd(q, k, v, mask_mode, scale, block_q,
+                                   block_k, interpret, out_dtype)
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, mask_mode, scale, block_q, block_k, interpret,
+                   out_dtype):
+    qd = q if out_dtype is None else q.astype(out_dtype)
+    out, res = _flash_fwd(qd, k, v, mask_mode, scale, block_q, block_k,
+                          interpret)
+    return (out, res[4]), (q, k, v, out, res[4])
+
+
+def _flash_lse_bwd(mask_mode, scale, block_q, block_k, interpret,
+                   out_dtype, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    # ds_ij = p_ij (dp_ij - delta_i + g_lse_i): the lse cotangent enters
+    # the softmax backward exactly like -delta (dL/ds_ij = p_ij), so it
+    # folds into the delta operand and the kernels run unchanged.
+    delta = jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1) - g_lse.astype(jnp.float32)       # [BH, S]
+    return _run_bwd_kernels(q, k, v, g_out, lse, delta, mask_mode, scale,
+                            block_q, block_k, interpret)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 @functools.lru_cache(maxsize=None)
@@ -368,6 +428,43 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def reshape_in(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
+    mode = MASK_CAUSAL if causal else MASK_NONE
     out = _flash(reshape_in(q), reshape_in(k), reshape_in(v),
-                 causal, scale, block_q, block_k, interpret)
+                 mode, scale, block_q, block_k, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *,
+                        mask_mode: int = MASK_NONE,
+                        scale: Optional[float] = None,
+                        block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None,
+                        out_dtype=None):
+    """Flash attention returning ``(out [B,S,H,D], lse [B,H,S])``, both
+    differentiable — the per-hop building block of ring_flash_attention
+    (the cross-hop merge weights depend on lse, so its cotangent is
+    nonzero).  ``mask_mode`` is one of MASK_NONE / MASK_CAUSAL /
+    MASK_STRICT applied on LOCAL block indices (ring hops pick the mode
+    per hop from the block owner)."""
+    _require_pltpu()
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"flash_attention_lse requires seq len {S} divisible by block "
+            f"sizes ({block_q}, {block_k})")
+
+    def reshape_in(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    out, lse = _flash_lse(reshape_in(q), reshape_in(k), reshape_in(v),
+                          mask_mode, scale, block_q, block_k, interpret,
+                          out_dtype)
+    return (out.reshape(B, H, S, D).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, S))

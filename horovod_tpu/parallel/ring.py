@@ -170,6 +170,103 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         *,
+                         axis_name: str = "hvd",
+                         causal: bool = False,
+                         scale: Optional[float] = None,
+                         striped: bool = False,
+                         block_q: int = 128,
+                         block_k: int = 128,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """``ring_attention`` with the per-hop block math in the Pallas flash
+    kernel (parallel/flash.py) instead of XLA einsums.
+
+    Same contract and layouts as :func:`ring_attention`; the difference is
+    WHERE the [Sq, Sk] score block lives: the XLA formulation materializes
+    it in HBM every hop, the flash kernel streams it through VMEM tiles
+    (FlashAttention-2), with each hop emitting a normalized partial output
+    plus its per-row logsumexp and the hops combined by the standard
+    (out, lse) logsumexp merge — exact, not approximate.  The merge
+    weights depend on lse, so the per-hop kernel is differentiable in
+    both outputs (flash_attention_lse); the hop body is rematerialized in
+    the backward like ring_attention's.
+
+    Per-hop masks map to static kernel variants chosen by the traced
+    block owner via ``lax.cond``: contiguous causal = NONE below the
+    diagonal / CAUSAL on it / skip above it (a skipped hop's lse is
+    forced to -inf, zeroing its merge weight and its gradients); striped
+    causal = CAUSAL for owner <= my, STRICT above (rows a strict hop
+    fully masks carry -inf lse and drop out of the merge the same way).
+    """
+    from .flash import (MASK_CAUSAL, MASK_NONE, MASK_STRICT,
+                        flash_attention_lse)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    neg_inf = jnp.float32(-1e30)
+
+    def hop_flash(mode):
+        def run(args):
+            qq, kk, vv = args
+            # f32 partials: ONE quantization to q.dtype at the end of the
+            # ring, not one per hop.
+            return flash_attention_lse(
+                qq, kk, vv, mask_mode=mode, scale=scale,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+                out_dtype=jnp.float32)
+        return run
+
+    # Carries derived from the varying inputs (see ring_attention's note
+    # on scan carry typing under shard_map).  K/V rotate in f32 like
+    # ring_attention's carries: bf16 rotation would halve ICI traffic,
+    # but it would also accumulate the K/V carry COTANGENTS across n hops
+    # in bf16 — a gradient-precision regression the "matches
+    # ring_attention" contract refuses.
+    out_acc = jnp.einsum("bqhd->bqhd", q.astype(jnp.float32)) * 0.0
+    lse_acc = jnp.einsum("bqhd->bhq", q.astype(jnp.float32)) * 0.0 + neg_inf
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def round_fn(carry, step):
+        kv_k, kv_v, out_acc, lse_acc = carry
+        owner = (my + step) % n
+        args = (q, kv_k, kv_v)
+        if causal and striped:
+            o_h, lse_h = lax.cond(owner <= my, hop_flash(MASK_CAUSAL),
+                                  hop_flash(MASK_STRICT), args)
+        elif causal:
+            o_h, lse_h = lax.cond(owner == my, hop_flash(MASK_CAUSAL),
+                                  hop_flash(MASK_NONE), args)
+            # Blocks above the diagonal contribute nothing: -inf lse
+            # zeroes their merge weight AND their gradient path.
+            lse_h = jnp.where(owner > my, neg_inf, lse_h)
+        else:
+            o_h, lse_h = hop_flash(MASK_NONE)(args)
+        # (out, lse) logsumexp merge with masked-row guards: a fully
+        # masked row's lse is ~-1e30 and its (undefined) output must get
+        # weight exactly 0 — plain logaddexp would give two -inf sources
+        # weight 0.5 each.
+        masked_a = lse_acc <= neg_inf * 0.5
+        masked_h = lse_h <= neg_inf * 0.5
+        lse_new = jnp.where(
+            masked_h, lse_acc,
+            jnp.where(masked_a, lse_h, jnp.logaddexp(lse_acc, lse_h)))
+        w_a = jnp.where(masked_a, 0.0, jnp.exp(lse_acc - lse_new))
+        w_h = jnp.where(masked_h, 0.0, jnp.exp(lse_h - lse_new))
+        bcast = lambda w: jnp.einsum("bhq->bqh", w)[..., None]  # noqa: E731
+        out_new = out_acc * bcast(w_a) + o_h.astype(jnp.float32) * bcast(w_h)
+        kv_k = lax.ppermute(kv_k, axis_name, perm)
+        kv_v = lax.ppermute(kv_v, axis_name, perm)
+        return (kv_k, kv_v, out_new, lse_new), None
+
+    (kv_k, kv_v, out_acc, lse_acc), _ = lax.scan(
+        jax.checkpoint(round_fn),
+        (k.astype(jnp.float32), v.astype(jnp.float32), out_acc, lse_acc),
+        jnp.arange(n, dtype=jnp.int32))
+    return out_acc.astype(q.dtype)
+
+
 def ring_attention_reference(q, k, v, *, causal: bool = False,
                              scale: Optional[float] = None):
     """Unsharded reference attention (for tests): q/k/v [B, S, H, D]."""
