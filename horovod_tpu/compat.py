@@ -1,0 +1,98 @@
+"""Guarded JAX API shims so the package runs on older jaxlibs.
+
+The codebase targets the modern public surface (``jax.shard_map``,
+``jax.typeof``, ``jax.lax.pvary``/``pcast``, ``pltpu.CompilerParams``); the
+container this grows in ships jax 0.4.37, where those live under older
+names (``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pltpu.TPUCompilerParams``) or do not exist (varying-manual-axes
+tracking).  Every patch below is guarded by ``hasattr`` so a modern jax is
+left completely untouched, and each maps to the closest older semantic:
+
+* ``jax.shard_map``        -> experimental shard_map; the ``check_vma``
+  kwarg is accepted and dropped, and ``check_rep`` defaults to False: the
+  0.4.x replication checker cannot infer replication through several
+  patterns this codebase relies on (psum-fed optimizer updates behind
+  ``out_specs=P()``, scan-carried collectives) and would reject programs
+  the modern vma checker accepts.  Disabling it changes no computed
+  values — it is a static checker; code that truly needs vma TRACKING
+  (DistributedOptimizer(reduce_axes=...)) probes for it and fails loudly
+  (optimizer.py) instead of silently degrading.
+* ``jax.typeof``           -> ``jax.core.get_aval``.  Old avals carry no
+  ``.vma`` set; every caller in this repo reads it via ``getattr(...,
+  "vma", <default>)``, and code that NEEDS real varying-tracking to be
+  correct (DistributedOptimizer(reduce_axes=...)) probes for it and fails
+  loudly rather than guessing (optimizer.py).
+* ``jax.lax.axis_size``    -> ``jax.core.axis_frame`` (which in 0.4.x
+  returns the bound axis's static size, raising NameError when unbound —
+  the same contract).
+* ``jax.lax.pvary``/``pcast`` -> identity.  Without vma tracking there is
+  no type distinction to cast between; the values are unchanged, which is
+  exactly what these ops compute.
+* ``pltpu.CompilerParams`` -> ``pltpu.TPUCompilerParams`` (renamed
+  upstream).
+
+Imported for its side effect at the top of ``horovod_tpu/__init__``; safe
+to import any number of times.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def has_vma_tracking() -> bool:
+    """True when this jax carries varying-manual-axes sets on avals
+    (``jax.typeof(x).vma``) — the capability DistributedOptimizer
+    (reduce_axes=...) and the multi-axis dryrun phases require.  On a
+    shimmed 0.4.x jax the attribute does not exist at all, so callers can
+    degrade explicitly instead of tripping optimizer.py's loud probe."""
+    import jax
+    import jax.numpy as jnp
+
+    return hasattr(jax.typeof(jnp.zeros(())), "vma")
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+            del check_vma  # no vma tracking on 0.4.x; see module docstring
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "typeof"):
+        import jax.core
+
+        jax.typeof = jax.core.get_aval
+
+    if not hasattr(jax.lax, "axis_size"):
+        import jax.core
+
+        # 0.4.x: core.axis_frame(name) IS the static axis size (and raises
+        # NameError for an unbound name, matching axis_index).
+        jax.lax.axis_size = jax.core.axis_frame
+
+    if not hasattr(jax.lax, "pvary"):
+        jax.lax.pvary = lambda x, axes: x
+
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = lambda x, axis, to="varying": x
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if not hasattr(pltpu, "CompilerParams") and \
+                hasattr(pltpu, "TPUCompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pragma: no cover
+        pass
+
+
+install()

@@ -111,13 +111,32 @@ def _maybe_join_distributed(cfg: _config.Config) -> None:
     # Healthy same-world resets clear the barrier in well under a second.
     shutdown_timeout = int(float(os.environ.get(
         "HVD_TPU_DIST_SHUTDOWN_TIMEOUT_S", "60")))
-    jax.distributed.initialize(
+    # Multi-process CPU worlds (the hermetic e2e test environment, and any
+    # CPU-fallback deployment) need a cross-host collectives transport; on
+    # jax 0.4.x the CPU backend refuses multiprocess computations unless
+    # the gloo implementation is selected BEFORE the backend client is
+    # created.  A no-op where unsupported/already-default, and irrelevant
+    # to TPU backends (the flag only affects CPU clients).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    kwargs = dict(
         coordinator_address=coordinator,
         num_processes=int(size),
         process_id=int(rank),
         initialization_timeout=init_timeout,
         shutdown_timeout_seconds=shutdown_timeout,
     )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except TypeError:
+        # Older jax (< 0.6) has no shutdown_timeout_seconds: the barrier
+        # bound is lost (a doomed survivor hangs the full default before
+        # aborting), but the world still forms — strictly better than not
+        # initializing at all.
+        kwargs.pop("shutdown_timeout_seconds")
+        jax.distributed.initialize(**kwargs)
 
 
 def init(comm: Optional[Sequence[int]] = None,

@@ -52,8 +52,18 @@ def _build() -> None:
                            check=True, capture_output=True)
             subprocess.run(["make", "-s", "-C", _DIR], check=True,
                            capture_output=True)
+            # Sweep only OTHER tags.  Unlinking the tag being produced
+            # opens a window where a reader that already passed its
+            # exists() check dlopens a missing path (os.replace below
+            # overwrites it atomically, no unlink needed); the ENOENT
+            # races left are absorbed by lib()'s one-shot retry.
             for stale in glob.glob(os.path.join(_DIR, "libhvdcore.abi*.so")):
-                os.remove(stale)
+                if os.path.abspath(stale) == _SO_TAGGED:
+                    continue
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass  # a concurrent sweep already got it
             tmp = _SO_TAGGED + ".tmp"
             shutil.copy2(_SO, tmp)
             os.replace(tmp, _SO_TAGGED)
@@ -71,7 +81,20 @@ def lib() -> ctypes.CDLL:
             return _lib
         if not os.path.exists(_SO_TAGGED):
             _build()
-        l = ctypes.CDLL(_SO_TAGGED)
+        try:
+            l = ctypes.CDLL(_SO_TAGGED)
+        except OSError:
+            # Lost a race with another process's _build() (an older tree's
+            # sweep could unlink the tagged file between our exists()
+            # check and dlopen) or found a damaged artifact: force one
+            # real rebuild — remove the tag so _build() cannot take its
+            # already-exists early return — and retry once.
+            try:
+                os.remove(_SO_TAGGED)
+            except OSError:
+                pass
+            _build()
+            l = ctypes.CDLL(_SO_TAGGED)
         l.hvd_core_abi_version.restype = ctypes.c_int
         if l.hvd_core_abi_version() != _ABI:
             raise RuntimeError(

@@ -322,18 +322,39 @@ struct Server {
 // HTTP plumbing
 // ---------------------------------------------------------------------------
 
+// Framing caps: a header block or declared body beyond these answers 400
+// and drops the connection instead of buffering without bound (a garbage or
+// hostile peer could otherwise OOM the one launcher host the control plane
+// runs on).  Control-plane payloads are small; result gathers and batch
+// puts stay far under the body cap.
+static const size_t kMaxHeaderBytes = size_t(1) << 20;   // 1 MiB
+static const size_t kMaxBodyBytes = size_t(1) << 30;     // 1 GiB
+
 struct Conn {
   int fd;
   std::string buf;   // unconsumed bytes
   bool ok = true;
+  bool oversize = false;  // framing cap exceeded: answer 400, then close
 
   explicit Conn(int f) : fd(f) {}
 
   // Read until the buffer contains `delim`; returns position or npos.
-  size_t read_until(const std::string& delim) {
+  // Stops (oversize) once more than `cap` bytes accumulate without the
+  // delimiter appearing.
+  size_t read_until(const std::string& delim, size_t cap) {
     while (true) {
       size_t pos = buf.find(delim);
-      if (pos != std::string::npos) return pos;
+      if (pos != std::string::npos) {
+        if (pos > cap) {
+          oversize = true;
+          return std::string::npos;
+        }
+        return pos;
+      }
+      if (buf.size() > cap + delim.size()) {
+        oversize = true;
+        return std::string::npos;
+      }
       char tmp[8192];
       ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
       if (n <= 0) {
@@ -344,7 +365,11 @@ struct Conn {
     }
   }
 
-  bool read_n(size_t n, std::string* out) {
+  bool read_n(size_t n, std::string* out, size_t cap) {
+    if (n > cap) {
+      oversize = true;
+      return false;
+    }
     while (buf.size() < n) {
       char tmp[8192];
       ssize_t r = recv(fd, tmp, sizeof(tmp), 0);
@@ -381,7 +406,7 @@ struct Request {
 };
 
 static bool parse_request(Conn* c, Request* rq) {
-  size_t hdr_end = c->read_until("\r\n\r\n");
+  size_t hdr_end = c->read_until("\r\n\r\n", kMaxHeaderBytes);
   if (hdr_end == std::string::npos) return false;
   std::string head = c->buf.substr(0, hdr_end);
   c->buf.erase(0, hdr_end + 4);
@@ -450,7 +475,7 @@ static bool parse_request(Conn* c, Request* rq) {
     p = amp + 1;
   }
   if (clen > 0) {
-    if (!c->read_n(clen, &rq->body)) return false;
+    if (!c->read_n(clen, &rq->body, kMaxBodyBytes)) return false;
   } else {
     rq->body.clear();
   }
@@ -654,7 +679,13 @@ static void serve_conn(std::shared_ptr<Server> s, int fd) {
   Conn c(fd);
   Request rq;
   while (!s->stopping && c.ok) {
-    if (!parse_request(&c, &rq)) break;
+    if (!parse_request(&c, &rq)) {
+      // An over-cap header/body gets an explicit 400 before the close;
+      // the stream position is unrecoverable, so the connection ends
+      // either way.  Plain EOF/reset just closes.
+      if (c.oversize && c.ok) respond(&c, 400, "");
+      break;
+    }
     if (rq.method == "PUT") {
       handle_put(s.get(), &c, rq);
     } else if (rq.method == "POST") {
@@ -806,6 +837,8 @@ uint8_t* hvd_kv_get(void* h, const char* scope, const char* key,
   // malloc(0) may return nullptr, which the caller reads as "absent":
   // always allocate at least one byte so an empty value round-trips as b"".
   uint8_t* out = (uint8_t*)malloc(kit->second.size() + 1);
+  if (!out) return nullptr;  // allocation failure reads as "absent"
+  // (*len stays -1), never a memcpy through nullptr
   memcpy(out, kit->second.data(), kit->second.size());
   *len = (int64_t)kit->second.size();
   return out;
@@ -834,6 +867,7 @@ char* hvd_kv_scan_json(void* h, const char* scope) {
   }
   body += "}";
   char* out = (char*)malloc(body.size() + 1);
+  if (!out) return nullptr;
   memcpy(out, body.c_str(), body.size() + 1);
   return out;
 }

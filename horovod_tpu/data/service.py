@@ -177,10 +177,13 @@ class RemoteDataset:
 
     With a rendezvous address, producers are discovered from the registry
     EVERY sweep (late joiners serve the tail of the epoch; stale-heartbeat
-    producers are skipped).  A connection failure evicts the producer only
-    when its heartbeat is stale — transient failures of a live producer
-    are retried.  With a static ``endpoints`` list (no registry), eviction
-    falls back to ``max_failures`` consecutive connection errors."""
+    producers are skipped).  A connection failure evicts the producer when
+    its heartbeat is stale, OR after ``max_failures`` consecutive
+    connection errors even with a fresh heartbeat (a wedged serving side
+    under a live heartbeat thread must not stall every sweep forever);
+    short transient failure streaks of a live producer are retried.  With
+    a static ``endpoints`` list (no registry), eviction uses the
+    ``max_failures`` streak alone."""
 
     def __init__(self, endpoints: Optional[List[str]] = None,
                  rendezvous_addr: Optional[str] = None,
@@ -299,19 +302,39 @@ class RemoteDataset:
     def _evict(self, ep: str, failures: Dict[str, int],
                err: Exception) -> bool:
         """Decide whether a connection failure means DEAD (evict) or
-        transient (retry): registry mode checks the heartbeat, static
-        mode counts consecutive failures."""
+        transient (retry).  Registry mode evicts on a STALE heartbeat
+        (crashed producer) — and, like static mode, on ``max_failures``
+        consecutive connection errors even while the heartbeat stays
+        fresh: the heartbeat thread and the serving socket are
+        independent, so a wedged HTTP server under a healthy heartbeat
+        would otherwise be retried forever and stall every sweep.
+        Static mode counts consecutive failures only."""
         if self._client is not None:
             reg = self._registry()
-            if reg is None or ep in reg:
-                # Heartbeat fresh — or registry unreachable (unknown
-                # liveness must not evict a possibly-live producer).
+            if reg is None:
+                # Registry unreachable: liveness is UNKNOWN — this is as
+                # likely the consumer's own network blip as the producer's
+                # fault, so neither eviction rule may fire and the failure
+                # does NOT count toward the streak (a blip-inflated streak
+                # would evict a healthy producer on its first real
+                # transient error after recovery).
                 return False
-            get_logger().warning(
-                "data-service producer %s unreachable with a stale "
-                "heartbeat; evicting (its undelivered batches are lost, "
-                "the epoch completes from the survivors): %s", ep, err)
-            return True
+            failures[ep] = failures.get(ep, 0) + 1
+            if ep not in reg:
+                get_logger().warning(
+                    "data-service producer %s unreachable with a stale "
+                    "heartbeat; evicting (its undelivered batches are "
+                    "lost, the epoch completes from the survivors): %s",
+                    ep, err)
+                return True
+            if failures[ep] >= self._max_failures:
+                get_logger().warning(
+                    "data-service producer %s refused %d consecutive "
+                    "connections despite a fresh heartbeat (serving side "
+                    "wedged); evicting: %s", ep, failures[ep], err)
+                return True
+            # Heartbeat fresh and the failure streak still short: retry.
+            return False
         failures[ep] = failures.get(ep, 0) + 1
         if failures[ep] >= self._max_failures:
             get_logger().warning(

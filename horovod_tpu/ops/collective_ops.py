@@ -118,7 +118,15 @@ def _ring_reduce(x: jax.Array, axis_name: str, op_fn,
     the (group) ring N-1 times, folding with ``op_fn`` — O(|x|) memory,
     N-1 ICI hops.  The ring neighbor permutation is identical every hop, so
     the loop stays a compact ``fori_loop`` (compiler-friendly control flow,
-    no O(N) program blowup)."""
+    no O(N) program blowup).
+
+    Double-buffered schedule (same shape as parallel/ring.py's): the
+    hop-(i+1) ``ppermute`` is issued on the already-received buffer BEFORE
+    the hop-i fold, so the ICI transfer carries no data dependency on the
+    fold and XLA's async collective scheduler can overlap them; the first
+    transfer is prefetched ahead of the loop and the last hop folds
+    outside it, keeping total transfers at N-1.  Fold order (and therefore
+    float bit patterns) is identical to the serial schedule."""
     if groups is None:
         n = lax.axis_size(axis_name)
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -128,13 +136,15 @@ def _ring_reduce(x: jax.Array, axis_name: str, op_fn,
     if n == 1:
         return x
 
+    first = lax.ppermute(x, axis_name, perm)  # hop-1 data, prefetched
+
     def body(_, carry):
         acc, cur = carry
-        cur = lax.ppermute(cur, axis_name, perm)
-        return op_fn(acc, cur), cur
+        nxt = lax.ppermute(cur, axis_name, perm)  # hop-(i+1) transfer first
+        return op_fn(acc, cur), nxt
 
-    acc, _ = lax.fori_loop(0, n - 1, body, (x, x))
-    return acc
+    acc, last = lax.fori_loop(0, n - 2, body, (x, first))
+    return op_fn(acc, last)  # final hop: fold only, nothing left to rotate
 
 
 def allreduce(x: jax.Array,

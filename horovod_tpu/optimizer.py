@@ -65,8 +65,18 @@ def _axis_bound(axis: str) -> bool:
 def _is_invariant(x, axis: str) -> bool:
     """True when ``x`` does not vary over the mesh axis (vma semantics):
     under shard_map, gradients w.r.t. replicated parameters come back
-    *already psum'd* by the transpose rule, so they are axis-invariant."""
-    return axis not in getattr(jax.typeof(x), "vma", frozenset())
+    *already psum'd* by the transpose rule, so they are axis-invariant.
+
+    Without vma tracking (jax 0.4.x via compat.py shims) the aval carries
+    no ``vma`` set at all.  There the OLD shard_map transpose (check_rep
+    False) hands back the shard-LOCAL cotangent for replicated params —
+    nothing arrives pre-summed — so the correct degraded answer is
+    "everything varies": always run the reduction.  Returning invariant
+    on a missing attribute would silently skip every psum."""
+    vma = getattr(jax.typeof(x), "vma", None)
+    if vma is None:
+        return False
+    return axis not in vma
 
 
 def _to_varying(tree, axis: str):

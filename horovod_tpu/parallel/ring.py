@@ -16,25 +16,120 @@ Math: blockwise softmax accumulation (the numerically-stable streaming form)
     acc_new = acc * corr + exp(s - m_new) @ v
 run in float32 islands regardless of input dtype.
 
-Causal masking is block-aware: a query block at ring position i fully
-attends K/V blocks from positions < i, applies the triangular mask at
-position i, and skips (masks entirely) positions > i.  Work is uniform per
-step, as SPMD requires; the skipped blocks cost one masked matmul — the
-standard trade in SPMD ring attention (a load-balanced "striped" variant is
-a layout change on top, not a different algorithm).
+Hop schedule (``schedule="overlap"``, the default): the ring is
+**double-buffered** — two K/V buffer pairs ride the scan carry, and each
+hop issues the *next* hop's ``ppermute`` on the already-received spare
+buffer BEFORE running the current hop's kernel/fold.  The transfer and the
+compute share no data dependency inside the hop body, so XLA's async
+collective scheduler can put the ICI transfer of hop t+1 under the MXU
+work of hop t (the latency hiding Ring Attention, Liu et al. 2023, is
+built around).  Total ICI traffic is n-1 rotations — one FEWER than the
+serial schedule, whose final compute-then-rotate iteration issues a dead
+rotation (the prefetch lands before the scan, the scan issues hops
+2..n-1, and the last two hops fold after it with both buffers in hand).
+``schedule="serial"`` keeps the legacy issue order — compute, then
+rotate — as the parity/bench reference.
+
+Causal masking is block-aware.  In the contiguous layout a query block at
+ring position i fully attends K/V blocks from positions < i, applies the
+triangular mask at position i, and — under the overlap schedule — **truly
+skips** positions > i: a ``lax.cond``/``lax.switch`` arm returns the
+accumulator unchanged (einsum path) or ``(zeros, -inf)`` (flash path)
+without touching the MXU.  (Earlier revisions described these hops as
+"skipped" while actually running a fully-masked kernel and discarding the
+result — roughly half the ring's kernel FLOPs at large n.  The serial
+schedule still behaves that way, by design, so the two schedules can be
+pinned against each other.)  The striped layout balances the mask across
+hops instead — every hop is near-triangular, so no whole hop is skippable
+(except the degenerate one-row-per-shard case, which the flash path does
+skip) but no hop is mostly wasted either.
 
 Layout contract: q, k, v are the *local sequence shards* ``[B, S/n, H, D]``
 inside shard_map with the sequence dimension sharded over ``axis_name``.
+
+Observability: ``set_ring_timeline`` registers a ``timeline.Timeline`` to
+receive the per-hop schedule (hop index, bytes rotated, mask rule, shards
+skipping) at trace time; ``set_ring_kernel_callback`` registers a runtime
+callback fired (via ``jax.debug.callback``) each time a per-hop flash
+kernel actually executes — skip arms never fire it, which is how tests
+prove the skip is real.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+SCHEDULES = ("overlap", "serial")
+
+# -- observability hooks ------------------------------------------------------
+
+# (Timeline, tensor_name) receiving trace-time hop-schedule events, plus
+# the configs already emitted: one jitted fwd+grad call retraces the ring
+# several times (forward, grad, checkpoint remat), and each retrace would
+# otherwise duplicate the whole hop schedule.
+_ring_timeline = None
+_ring_timeline_seen: set = set()
+# Runtime callback fired from inside executed flash-kernel branches
+# (jax.debug.callback); the skip arm carries no callback, so counting
+# firings counts true kernel invocations.  Checked at TRACE time: set it
+# before building/jitting the program you want instrumented.
+_ring_kernel_callback: Optional[Callable[[int], None]] = None
+
+
+def set_ring_timeline(timeline, tensor_name: str = "ring") -> None:
+    """Register a ``timeline.Timeline`` (or None to clear) to receive the
+    per-hop ring schedule — hop index, bytes rotated, mask rule, schedule,
+    and how many shards skip the hop's kernel — whenever a ring collective
+    is traced.  The device plane is invisible to the host timeline
+    (docs/timeline.md), so these are trace-time schedule events; measured
+    kernel/transfer spans come from the bench microbench via
+    ``Timeline.ring_span``.  Each distinct ring configuration is emitted
+    once per registration — retraces (grad, checkpoint remat) of the same
+    call do not duplicate the schedule."""
+    global _ring_timeline
+    _ring_timeline = None if timeline is None else (timeline, tensor_name)
+    _ring_timeline_seen.clear()
+
+
+def set_ring_kernel_callback(cb: Optional[Callable[[int], None]]) -> None:
+    """Register a callback ``cb(mask_mode)`` fired at RUNTIME once per
+    executed per-hop flash kernel (skip arms never fire it).  Trace-time
+    registration: set before tracing/jitting the instrumented call."""
+    global _ring_kernel_callback
+    _ring_kernel_callback = cb
+
+
+def _emit_hop_schedule(kind: str, n: int, bytes_per_hop: int, causal: bool,
+                       striped: bool, schedule: str) -> None:
+    if _ring_timeline is None:
+        return
+    key = (kind, n, bytes_per_hop, causal, striped, schedule)
+    if key in _ring_timeline_seen:
+        return  # retrace of an already-recorded configuration
+    _ring_timeline_seen.add(key)
+    tl, name = _ring_timeline
+    mask = ("causal-striped" if causal and striped else
+            "causal-contiguous" if causal else "none")
+    for hop in range(n):
+        # Contiguous causal under the overlap schedule: hop t (t >= 1)
+        # carries the block of owner my+t, which is above the diagonal on
+        # the n-t shards with my < n-t — those shards take the skip arm.
+        skipped = 0
+        if causal and not striped and schedule == "overlap" and hop > 0:
+            skipped = n - hop
+        tl.ring_hop(f"{name}/{kind}", hop, bytes_rotated=bytes_per_hop,
+                    mask=mask, schedule=schedule, skipped_shards=skipped)
+
+
+def _check_schedule(schedule: str) -> None:
+    if schedule not in SCHEDULES:
+        raise ValueError(f"schedule must be one of {SCHEDULES}, "
+                         f"got {schedule!r}")
 
 
 def _block_scores(q32, k32, scale):
@@ -46,9 +141,9 @@ def stripe_sequence(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
     """Re-order a GLOBAL sequence into the striped layout: shard i receives
     tokens [i, i+n, i+2n, ...] instead of a contiguous block.  Under causal
     ring attention the striped layout balances the mask across ring hops
-    (contiguous blocks leave early hops fully masked on most shards — ~2x
-    wasted MXU work at large n).  Apply before sharding; invert with
-    ``unstripe_sequence``."""
+    (contiguous blocks concentrate the real work on late shards — the skip
+    arm saves the masked hops' FLOPs but cannot rebalance the remaining
+    work).  Apply before sharding; invert with ``unstripe_sequence``."""
     x = jnp.moveaxis(x, axis, 0)
     S = x.shape[0]
     if S % n:
@@ -83,7 +178,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = False,
                    scale: Optional[float] = None,
                    striped: bool = False,
-                   remat_hops: bool = True) -> jax.Array:
+                   remat_hops: bool = True,
+                   schedule: str = "overlap") -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args:
@@ -94,18 +190,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       striped: tokens are laid out round-robin (shard i holds global tokens
         i, i+n, ...; see ``stripe_sequence``).  With causal masking this
         balances the per-hop mask across shards: every hop attends a
-        near-triangular block instead of all-or-nothing, halving wasted
-        MXU work on wide rings.  Default False = contiguous blocks (shard i
-        holds tokens [i*S_local, (i+1)*S_local)).
+        near-triangular block instead of all-or-nothing.  Default False =
+        contiguous blocks (shard i holds tokens [i*S_local, (i+1)*S_local)).
       remat_hops: rematerialize each hop in the backward pass (default).
         Without it, scan autodiff saves every hop's [Sq, Sk] probability
         block — O(S_global * S_local) per device, the exact memory wall
         ring attention exists to avoid; with it, the backward recomputes
         the block scores from the streamed K/V (the RingAttention
         recipe's memory bound) at ~one extra forward of FLOPs.
+      schedule: "overlap" (default) double-buffers the ring — the next
+        hop's K/V ``ppermute`` is issued on a spare buffer before the
+        current hop's fold, so ICI transfer hides under compute (and one
+        rotation fewer runs than serial: n-1 vs n), and contiguous-causal
+        above-diagonal hops take a true skip branch (no score einsum at
+        all).  "serial" is the legacy compute-then-rotate order with
+        masked (but executed) hops; both schedules produce identical
+        values and gradients.
 
     Returns local attention output [B, S_local, H, D] (same sharding as q).
     """
+    _check_schedule(schedule)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -135,36 +239,98 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         tri_mask = iota_q >= iota_k        # within-block causal
         tri_strict = iota_q > iota_k       # striped off-diagonal rule
 
-    def round_fn(carry, step):
-        kv_k, kv_v, acc, m, l = carry
-        owner = (my + step) % n  # global position of the current K/V block
-        s = _block_scores(q32, kv_k, scale)  # [B, H, Sq, Sk]
-        if causal and striped:
-            # Striped layout: query a (global a*n + my) attends key b
-            # (global b*n + owner) iff b < a, or b == a and owner <= my —
-            # a near-triangular mask at EVERY hop (balanced work).
-            block_mask = jnp.where(owner <= my, tri_mask, tri_strict)
-            s = jnp.where(block_mask[None, None], s, neg_inf)
-        elif causal:
-            # Block-contiguous layout: owner < my -> full attend;
-            # owner == my -> triangular; owner > my -> fully masked.
-            block_mask = jnp.where(
-                owner == my, tri_mask,
-                jnp.broadcast_to(owner < my, tri_mask.shape))
-            s = jnp.where(block_mask[None, None], s, neg_inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, kv_v)
-        kv_k = lax.ppermute(kv_k, axis_name, perm)
-        kv_v = lax.ppermute(kv_v, axis_name, perm)
-        return (kv_k, kv_v, acc_new, m_new, l_new), None
+    _emit_hop_schedule("ring_attention", n, 2 * B * Sq * H * D * 4,
+                       causal, striped, schedule)
 
-    body = jax.checkpoint(round_fn) if remat_hops else round_fn
-    init = (k.astype(jnp.float32), v.astype(jnp.float32), acc, m, l)
-    (kv_k, kv_v, acc, m, l), _ = lax.scan(
-        body, init, jnp.arange(n, dtype=jnp.int32))
+    def fold(kv_k, kv_v, acc, m, l, step, allow_skip):
+        """One hop's online-softmax fold; identical math in both schedules.
+
+        ``allow_skip`` (overlap schedule only): contiguous-causal hops with
+        owner > my are fully masked — numerically an exact no-op after the
+        step-0 diagonal hop establishes a finite running max (p underflows
+        to exactly 0.0) — so a lax.cond arm returns the state untouched
+        without computing the score block at all."""
+        owner = (my + step) % n  # global position of the current K/V block
+
+        def compute(args):
+            kv_k, kv_v, acc, m, l = args
+            s = _block_scores(q32, kv_k, scale)  # [B, H, Sq, Sk]
+            if causal and striped:
+                # Striped layout: query a (global a*n + my) attends key b
+                # (global b*n + owner) iff b < a, or b == a and
+                # owner <= my — a near-triangular mask at EVERY hop
+                # (balanced work).
+                block_mask = jnp.where(owner <= my, tri_mask, tri_strict)
+                s = jnp.where(block_mask[None, None], s, neg_inf)
+            elif causal:
+                # Block-contiguous layout: owner < my -> full attend;
+                # owner == my -> triangular; owner > my -> fully masked.
+                block_mask = jnp.where(
+                    owner == my, tri_mask,
+                    jnp.broadcast_to(owner < my, tri_mask.shape))
+                s = jnp.where(block_mask[None, None], s, neg_inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, kv_v)
+            return acc_new, m_new, l_new
+
+        args = (kv_k, kv_v, acc, m, l)
+        if allow_skip and causal and not striped:
+            return lax.cond(owner > my,
+                            lambda a: (a[2], a[3], a[4]),  # true skip
+                            compute, args)
+        return compute(args)
+
+    k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+
+    if schedule == "serial":
+        def round_fn(carry, step):
+            kv_k, kv_v, acc, m, l = carry
+            acc, m, l = fold(kv_k, kv_v, acc, m, l, step, False)
+            kv_k = lax.ppermute(kv_k, axis_name, perm)
+            kv_v = lax.ppermute(kv_v, axis_name, perm)
+            return (kv_k, kv_v, acc, m, l), None
+
+        body = jax.checkpoint(round_fn) if remat_hops else round_fn
+        (_, _, acc, m, l), _ = lax.scan(
+            body, (k32, v32, acc, m, l), jnp.arange(n, dtype=jnp.int32))
+    elif n == 1:
+        # Single shard: one fold, no rotation at all.
+        tail = lambda: fold(k32, v32, acc, m, l, 0, True)  # noqa: E731
+        acc, m, l = (jax.checkpoint(tail) if remat_hops else tail)()
+    else:
+        # Double-buffered: the carry holds the CURRENT hop's K/V and the
+        # next hop's, already in flight.  Each body iteration first issues
+        # the hop-(t+2) transfer on the spare buffer — no data dependency
+        # with the hop-t fold, so the transfer hides under the compute —
+        # then folds hop t.  The scan runs n-2 iterations (issuing hops
+        # 2..n-1); the LAST TWO hops fold outside it, where both buffers
+        # are already in hand and nothing remains to rotate — n-1 total
+        # rotations, one fewer than the serial schedule's n (whose final
+        # rotation is dead weight).
+        def round_fn(carry, step):
+            cur_k, cur_v, nxt_k, nxt_v, acc, m, l = carry
+            nn_k = lax.ppermute(nxt_k, axis_name, perm)
+            nn_v = lax.ppermute(nxt_v, axis_name, perm)
+            acc, m, l = fold(cur_k, cur_v, acc, m, l, step, True)
+            return (nxt_k, nxt_v, nn_k, nn_v, acc, m, l), None
+
+        nxt_k = lax.ppermute(k32, axis_name, perm)  # hop-1 prefetch, issued
+        nxt_v = lax.ppermute(v32, axis_name, perm)  # before the hop-0 fold
+        body = jax.checkpoint(round_fn) if remat_hops else round_fn
+        (cur_k, cur_v, nxt_k, nxt_v, acc, m, l), _ = lax.scan(
+            body, (k32, v32, nxt_k, nxt_v, acc, m, l),
+            jnp.arange(n - 2, dtype=jnp.int32))
+
+        def tail(ck, cv, nk, nv, a, mm, ll):
+            a, mm, ll = fold(ck, cv, a, mm, ll, n - 2, True)
+            return fold(nk, nv, a, mm, ll, n - 1, True)
+
+        if remat_hops:
+            tail = jax.checkpoint(tail)
+        acc, m, l = tail(cur_k, cur_v, nxt_k, nxt_v, acc, m, l)
 
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
@@ -178,7 +344,8 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          striped: bool = False,
                          block_q: int = 128,
                          block_k: int = 128,
-                         interpret: Optional[bool] = None) -> jax.Array:
+                         interpret: Optional[bool] = None,
+                         schedule: str = "overlap") -> jax.Array:
     """``ring_attention`` with the per-hop block math in the Pallas flash
     kernel (parallel/flash.py) instead of XLA einsums.
 
@@ -193,14 +360,22 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the backward like ring_attention's.
 
     Per-hop masks map to static kernel variants chosen by the traced
-    block owner via ``lax.cond``: contiguous causal = NONE below the
-    diagonal / CAUSAL on it / skip above it (a skipped hop's lse is
-    forced to -inf, zeroing its merge weight and its gradients); striped
-    causal = CAUSAL for owner <= my, STRICT above (rows a strict hop
-    fully masks carry -inf lse and drop out of the merge the same way).
+    block owner.  Contiguous causal under the default ``schedule=
+    "overlap"``: a three-arm ``lax.switch`` — NONE below the diagonal,
+    CAUSAL on it, and a TRUE SKIP above it that returns ``(zeros, -inf)``
+    without invoking the Pallas kernel (the -inf lse zeroes the hop's
+    merge weight and its gradient path, exactly as the executed-but-
+    discarded kernel did).  Striped causal = CAUSAL for owner <= my,
+    STRICT above (rows a strict hop fully masks carry -inf lse and drop
+    out of the merge); a strict hop is provably empty as a whole only in
+    the one-row-per-shard case (S_local == 1), where the skip arm replaces
+    the STRICT kernel.  ``schedule="serial"`` keeps the legacy two-arm
+    path that runs a full MASK_NONE kernel on above-diagonal hops and
+    discards it via forced -inf lse — the parity/bench reference.
     """
     from .flash import (MASK_CAUSAL, MASK_NONE, MASK_STRICT,
                         flash_attention_lse)
+    _check_schedule(schedule)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -210,6 +385,12 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def hop_flash(mode):
         def run(args):
             qq, kk, vv = args
+            if _ring_kernel_callback is not None:
+                # Runtime proof-of-execution: fires only when THIS branch
+                # runs (lax.cond/switch execute one arm), so skip arms are
+                # observable as absent firings.
+                cb = _ring_kernel_callback
+                jax.debug.callback(lambda cb=cb, mode=mode: cb(mode))
             # f32 partials: ONE quantization to q.dtype at the end of the
             # ring, not one per hop.
             return flash_attention_lse(
@@ -217,6 +398,15 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 block_q=block_q, block_k=block_k, interpret=interpret,
                 out_dtype=jnp.float32)
         return run
+
+    def hop_skip(args):
+        # True skip: no kernel invocation.  Outputs derived from q so the
+        # branch's varying-manual-axes types match the kernel arms'; the
+        # -inf lse gives the hop merge weight (and gradient) exactly 0.
+        qq, _, _ = args
+        o = qq.astype(jnp.float32) * 0.0
+        lse = jnp.einsum("bqhd->bhq", qq.astype(jnp.float32)) * 0.0 + neg_inf
+        return o, lse
 
     # Carries derived from the varying inputs (see ring_attention's note
     # on scan carry typing under shard_map).  K/V rotate in f32 like
@@ -228,19 +418,35 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     lse_acc = jnp.einsum("bqhd->bhq", q.astype(jnp.float32)) * 0.0 + neg_inf
     perm = [(i, (i - 1) % n) for i in range(n)]
 
-    def round_fn(carry, step):
-        kv_k, kv_v, out_acc, lse_acc = carry
+    _emit_hop_schedule("ring_flash_attention", n, 2 * B * Sq * H * D * 4,
+                       causal, striped, schedule)
+
+    def fold(kv_k, kv_v, out_acc, lse_acc, step, allow_skip):
         owner = (my + step) % n
         args = (q, kv_k, kv_v)
         if causal and striped:
-            o_h, lse_h = lax.cond(owner <= my, hop_flash(MASK_CAUSAL),
-                                  hop_flash(MASK_STRICT), args)
+            if allow_skip and Sq == 1:
+                # One row per shard: a strict hop masks its only row —
+                # the whole hop is provably empty, skip the kernel.
+                o_h, lse_h = lax.cond(owner <= my, hop_flash(MASK_CAUSAL),
+                                      hop_skip, args)
+            else:
+                o_h, lse_h = lax.cond(owner <= my, hop_flash(MASK_CAUSAL),
+                                      hop_flash(MASK_STRICT), args)
         elif causal:
-            o_h, lse_h = lax.cond(owner == my, hop_flash(MASK_CAUSAL),
-                                  hop_flash(MASK_NONE), args)
-            # Blocks above the diagonal contribute nothing: -inf lse
-            # zeroes their merge weight AND their gradient path.
-            lse_h = jnp.where(owner > my, neg_inf, lse_h)
+            if allow_skip:
+                # owner < my -> 0 (NONE), == -> 1 (CAUSAL), > -> 2 (skip).
+                arm = ((owner >= my).astype(jnp.int32) +
+                       (owner > my).astype(jnp.int32))
+                o_h, lse_h = lax.switch(
+                    arm, [hop_flash(MASK_NONE), hop_flash(MASK_CAUSAL),
+                          hop_skip], args)
+            else:
+                o_h, lse_h = lax.cond(owner == my, hop_flash(MASK_CAUSAL),
+                                      hop_flash(MASK_NONE), args)
+                # Blocks above the diagonal contribute nothing: -inf lse
+                # zeroes their merge weight AND their gradient path.
+                lse_h = jnp.where(owner > my, neg_inf, lse_h)
         else:
             o_h, lse_h = hop_flash(MASK_NONE)(args)
         # (out, lse) logsumexp merge with masked-row guards: a fully
@@ -256,14 +462,52 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         w_h = jnp.where(masked_h, 0.0, jnp.exp(lse_h - lse_new))
         bcast = lambda w: jnp.einsum("bhq->bqh", w)[..., None]  # noqa: E731
         out_new = out_acc * bcast(w_a) + o_h.astype(jnp.float32) * bcast(w_h)
-        kv_k = lax.ppermute(kv_k, axis_name, perm)
-        kv_v = lax.ppermute(kv_v, axis_name, perm)
-        return (kv_k, kv_v, out_new, lse_new), None
+        return out_new, lse_new
 
-    (kv_k, kv_v, out_acc, lse_acc), _ = lax.scan(
-        jax.checkpoint(round_fn),
-        (k.astype(jnp.float32), v.astype(jnp.float32), out_acc, lse_acc),
-        jnp.arange(n, dtype=jnp.int32))
+    k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+
+    if schedule == "serial":
+        def round_fn(carry, step):
+            kv_k, kv_v, out_acc, lse_acc = carry
+            out_acc, lse_acc = fold(kv_k, kv_v, out_acc, lse_acc, step,
+                                    False)
+            kv_k = lax.ppermute(kv_k, axis_name, perm)
+            kv_v = lax.ppermute(kv_v, axis_name, perm)
+            return (kv_k, kv_v, out_acc, lse_acc), None
+
+        (_, _, out_acc, lse_acc), _ = lax.scan(
+            jax.checkpoint(round_fn), (k32, v32, out_acc, lse_acc),
+            jnp.arange(n, dtype=jnp.int32))
+    elif n == 1:
+        out_acc, lse_acc = jax.checkpoint(
+            lambda: fold(k32, v32, out_acc, lse_acc, 0, True))()
+    else:
+        # Double-buffered schedule — see ring_attention.  The hop-(t+2)
+        # ppermute is issued on the spare buffer before the hop-t kernel;
+        # the last two hops fold outside the scan with both buffers in
+        # hand (n-1 rotations total, vs serial's n).
+        def round_fn(carry, step):
+            cur_k, cur_v, nxt_k, nxt_v, out_acc, lse_acc = carry
+            nn_k = lax.ppermute(nxt_k, axis_name, perm)
+            nn_v = lax.ppermute(nxt_v, axis_name, perm)
+            out_acc, lse_acc = fold(cur_k, cur_v, out_acc, lse_acc, step,
+                                    True)
+            return (nxt_k, nxt_v, nn_k, nn_v, out_acc, lse_acc), None
+
+        nxt_k = lax.ppermute(k32, axis_name, perm)  # hop-1 prefetch
+        nxt_v = lax.ppermute(v32, axis_name, perm)
+        (cur_k, cur_v, nxt_k, nxt_v, out_acc, lse_acc), _ = lax.scan(
+            jax.checkpoint(round_fn),
+            (k32, v32, nxt_k, nxt_v, out_acc, lse_acc),
+            jnp.arange(n - 2, dtype=jnp.int32))
+
+        def tail(ck, cv, nk, nv, oa, la):
+            oa, la = fold(ck, cv, oa, la, n - 2, True)
+            return fold(nk, nv, oa, la, n - 1, True)
+
+        out_acc, lse_acc = jax.checkpoint(tail)(
+            cur_k, cur_v, nxt_k, nxt_v, out_acc, lse_acc)
+
     return out_acc.astype(q.dtype)
 
 

@@ -36,6 +36,15 @@ XLA_EXECUTE = "XLA_EXECUTE"
 TRACE_CACHE_HIT = "TRACE_CACHE_HIT"
 TRACE_COMPILE = "TRACE_COMPILE"
 
+# Ring-collective hop events (no reference analog — the reference has no
+# ring/sequence parallelism).  RING_HOP carries the traced hop schedule
+# (parallel/ring.py set_ring_timeline); RING_KERNEL / RING_TRANSFER carry
+# measured per-hop spans (bench.py ring microbench) so kernel time and ICI
+# transfer time are separable in the trace viewer.
+RING_HOP = "RING_HOP"
+RING_KERNEL = "RING_KERNEL"
+RING_TRANSFER = "RING_TRANSFER"
+
 
 class Timeline:
     """Chrome-trace writer with a background writer thread
@@ -104,6 +113,34 @@ class Timeline:
     def end(self, tensor_name: str, op_type: str):
         self._put({"name": op_type, "ph": "E", "ts": self._ts_us(),
                    "pid": self.rank, "tid": tensor_name})
+
+    def ring_hop(self, tensor_name: str, hop: int, *, bytes_rotated: int,
+                 mask: str = "none", schedule: str = "overlap",
+                 skipped_shards: int = 0, dur_us: float = 0.0):
+        """One ring-collective hop of the traced schedule (complete-event
+        form): hop index, K/V bytes rotated over ICI that hop, the mask
+        rule, the hop schedule, and how many shards take the true-skip arm
+        instead of running a kernel.  Emitted at TRACE time by
+        parallel/ring.py when a timeline is registered via
+        ``set_ring_timeline`` — the device plane inside jit is invisible to
+        the host (module docstring), so these document the schedule, while
+        ``ring_span`` carries measured spans."""
+        self._put({"name": f"{RING_HOP}_{hop}", "ph": "X",
+                   "ts": self._ts_us(), "dur": dur_us,
+                   "pid": self.rank, "tid": tensor_name,
+                   "args": {"hop": hop, "bytes_rotated": bytes_rotated,
+                            "mask": mask, "schedule": schedule,
+                            "skipped_shards": skipped_shards}})
+
+    def ring_span(self, tensor_name: str, hop: int, kind: str,
+                  start_us: float, dur_us: float, **args):
+        """Measured span for one ring hop: ``kind`` is RING_KERNEL (per-hop
+        attention/fold compute) or RING_TRANSFER (the K/V ppermute).  Used
+        by the bench ring microbench, which times single-hop programs to
+        attribute step time to kernel vs transfer."""
+        self._put({"name": f"{kind}_{hop}", "ph": "X", "ts": start_us,
+                   "dur": dur_us, "pid": self.rank, "tid": tensor_name,
+                   "args": dict(args, hop=hop)})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,

@@ -109,6 +109,28 @@ def test_sigkill_at_any_point_leaves_parseable_record(planted_record,
     assert records[-1]["value"] == _FAKE_RECORD["value"]
 
 
+def test_probe_deadline_emits_fail_fast_record(planted_record):
+    """ISSUE 1 satellite: the probe loop must give up at its own deadline
+    (default well inside the driver's ~870 s window — BENCH_r05 showed the
+    unbounded loop riding to rc=124) and re-emit the fallback as a
+    fail-fast JSON line carrying the probe-failure metadata in-band."""
+    r = subprocess.run([sys.executable, _BENCH], env=_bench_env(_TAG),
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0  # never confusable with a fresh capture
+    records = _json_lines(r.stdout)
+    assert len(records) >= 2  # emit-first floor + fail-fast re-emission
+    last = records[-1]
+    assert last["stale"] is True
+    assert last["probe_failed"] is True
+    assert last["probe_attempts"] >= 1
+    assert last["probe_seconds"] >= 0
+    assert last["value"] == planted_record["value"]
+    assert "fail-fast" in r.stderr
+    # The on-disk capture stays clean — probe failure is never persisted.
+    with open(_RECORD_PATH) as f:
+        assert "probe_failed" not in json.load(f)
+
+
 def test_no_prior_capture_fails_with_clear_message():
     r = subprocess.run([sys.executable, _BENCH],
                        env=_bench_env("nosuchtagever"),
