@@ -457,7 +457,7 @@ def main():
 
     img_s = batch * iters / dt
     per_dev = img_s / nslots
-    _emit({
+    record = {
         "metric": "resnet50_synthetic_images_per_sec",
         "value": round(img_s, 2),
         "unit": "images/sec",
@@ -465,7 +465,18 @@ def main():
         "config": f"bs{bpc}/chip bf16 sync-bn "
                   f"{'s2d-stem' if fast_stem else 'naive-stem'}"
                   + (" SMOKE" if smoke else ""),
-    })
+    }
+    # HVD_ANALYZE=1: the shard_step hook checked the step program on first
+    # compile (analysis/hook.py); surface its per-step collective census
+    # (count + payload bytes per primitive) in the bench record so a perf
+    # number always names the collectives that produced it.  Reports only
+    # exist when the hook ran, so no separate env gate is needed.
+    from horovod_tpu import core as _core
+    reports = _core.analysis_reports()
+    if reports:
+        record["collective_census"] = reports[-1].census
+        record["analysis_findings"] = len(reports[-1].findings)
+    _emit(record)
 
 
 if __name__ == "__main__":

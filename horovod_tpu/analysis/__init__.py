@@ -1,0 +1,24 @@
+"""hvdlint — distributed-correctness static analysis for horovod_tpu.
+
+Two cooperating layers (see docs/static_analysis.md):
+
+* **AST linter** (rules.py / linter.py): rules HVD001-HVD008 over source
+  files — rank-guarded collectives, exception-swallowed collectives,
+  unseeded randomness / host side effects / wall clocks / closed-over
+  mutation inside traced functions, undeclared axis literals.  Stdlib
+  only; runs anywhere.
+* **jaxpr checker** (jaxpr_check.py): traces a step function and walks
+  the closed jaxpr (cond/scan/while/shard_map sub-jaxprs included) to
+  verify collective/axis consistency (HVD101/HVD102) and to build the
+  per-step collective census surfaced by timeline.py and bench.py.
+
+CLI: ``python -m horovod_tpu.analysis <paths>`` (or the ``hvdlint``
+console script / ``tools/hvdlint.py`` shim); exit 0 clean, 1 findings,
+2 internal error.  Trace-time mode: ``HVD_ANALYZE=1`` (hook.py).
+"""
+
+from .findings import ERROR, WARNING, Finding, Rule, RULES, unsuppressed  # noqa: F401
+from .linter import lint_file, lint_paths, lint_source, iter_python_files  # noqa: F401
+from .jaxpr_check import JaxprReport, check_closed_jaxpr, check_step_fn  # noqa: F401
+from .cli import main  # noqa: F401
+from . import hook  # noqa: F401

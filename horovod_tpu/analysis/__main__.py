@@ -1,0 +1,8 @@
+"""``python -m horovod_tpu.analysis`` — the hvdlint CLI."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

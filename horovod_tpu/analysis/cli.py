@@ -1,0 +1,96 @@
+"""hvdlint command line: ``python -m horovod_tpu.analysis <paths>``.
+
+Exit codes (CI contract, mirrored by tools/hvdlint.py and the
+``hvdlint`` console script):
+
+* 0 — no unsuppressed findings
+* 1 — at least one unsuppressed finding (including HVD000 parse
+  failures: a file the linter cannot read is a finding, not a crash)
+* 2 — usage error (argparse) or internal analyzer error
+
+Text output prints one block per finding (location, rule, severity,
+message, fix hint); ``--format json`` prints a single machine-readable
+object with the findings plus per-rule statistics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from typing import List, Optional, Sequence
+
+from .findings import RULES, unsuppressed
+from .linter import lint_paths
+
+
+def _split_ids(value: str) -> List[str]:
+    return [tok.strip().upper() for tok in value.split(",") if tok.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdlint",
+        description="Distributed-correctness static analyzer for "
+                    "horovod_tpu training code (rules HVD001-HVD008; see "
+                    "docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint (default: .)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", type=_split_ids, default=[],
+                   help="comma-separated rule IDs to run exclusively")
+    p.add_argument("--ignore", type=_split_ids, default=[],
+                   help="comma-separated rule IDs to skip")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by '# hvdlint: "
+                        "disable=...' pragmas")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def _print_rules() -> None:
+    for rule in RULES.values():
+        print(f"{rule.id} [{rule.severity}] {rule.summary}")
+        print(f"    fix: {rule.fix_hint}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        _print_rules()
+        return 0
+    try:
+        findings = lint_paths(args.paths, select=args.select,
+                              ignore=args.ignore)
+    except Exception as e:  # internal error: distinct from "has findings"
+        print(f"hvdlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    active = unsuppressed(findings)
+    shown = findings if args.show_suppressed else active
+    if args.format == "json":
+        by_rule = Counter(f.rule for f in active)
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "summary": {
+                "total": len(active),
+                "suppressed": len(findings) - len(active),
+                "by_rule": dict(sorted(by_rule.items())),
+            },
+        }, indent=1))
+    else:
+        for f in shown:
+            print(f.format())
+        suppressed_n = len(findings) - len(active)
+        tail = f" ({suppressed_n} suppressed)" if suppressed_n else ""
+        print(f"hvdlint: {len(active)} finding(s){tail} in "
+              f"{len(set(f.path for f in findings)) if findings else 0} "
+              f"flagged file(s)")
+    return 1 if active else 0
+
+
+def run_commandline() -> None:
+    """Console-script entry point (pyproject [project.scripts] hvdlint)."""
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Finding / Rule data model shared by the AST linter and the jaxpr checker.
+
+The reference catches the classic SPMD failure — ranks submitting different
+collective sequences — at RUNTIME, in the coordinator's negotiation phase
+(controller.cc ComputeResponseList: "Mismatched allreduce" stall warnings).
+hvdlint reports the same class of bug STATICALLY, so every finding carries
+the shape the negotiation error would have had: what diverges, where, and
+how to fix it before the job wedges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One lint rule: stable ID, severity, rationale, and a fix hint that is
+    attached verbatim to every finding it produces."""
+
+    id: str
+    severity: str
+    summary: str
+    fix_hint: str
+
+
+# ---------------------------------------------------------------------------
+# Rule catalogue.  HVD0xx = source-level (AST) rules; HVD1xx = trace-level
+# (jaxpr) rules; HVD000 is the analyzer's own loud-but-graceful degradation
+# channel (syntax errors, unreadable files).  docs/static_analysis.md renders
+# this table; tests/test_hvdlint.py exercises each AST rule on a seeded
+# violation corpus.
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Rule] = {r.id: r for r in [
+    Rule("HVD000", ERROR,
+         "analysis failure: the file could not be parsed (syntax error or "
+         "unreadable); reported as a finding instead of crashing the linter",
+         "fix the syntax error, or exclude the file from the lint paths"),
+    Rule("HVD001", ERROR,
+         "collective call guarded by rank-dependent control flow — only a "
+         "subset of ranks reaches the collective, the rest wait forever "
+         "(the deadlock Horovod's negotiation phase detects at runtime)",
+         "move the collective outside the `if rank() == ...` block; every "
+         "rank must execute the same collective sequence"),
+    Rule("HVD002", ERROR,
+         "collective inside a try/except whose handler swallows the "
+         "exception — a rank that raises skips the collective while the "
+         "others block in it",
+         "re-raise inside the handler (or raise HorovodInternalError) so "
+         "either every rank completes the collective or the job tears down"),
+    Rule("HVD003", ERROR,
+         "unseeded `random`/`np.random` global-state call inside a traced "
+         "function — each rank traces different constants, producing "
+         "divergent compiled programs and divergent model state",
+         "use jax.random with an explicitly shared PRNGKey, or a seeded "
+         "np.random.RandomState(seed)/default_rng(seed)"),
+    Rule("HVD004", WARNING,
+         "host side effect (print/open/io_callback) inside a traced step "
+         "function — runs at trace time only (or adds a host round-trip), "
+         "and ordered callbacks can serialize ranks",
+         "use jax.debug.print for traced values, or move host I/O outside "
+         "the step function"),
+    Rule("HVD005", WARNING,
+         ".block_until_ready()/jax.device_get inside the step function — "
+         "forces a device→host sync on the hot path, breaking XLA's "
+         "compute/collective overlap",
+         "fetch results outside the step; sync once per iteration batch at "
+         "most"),
+    Rule("HVD006", ERROR,
+         "collective names an axis that no enclosing mesh/shard_map/pmap in "
+         "this file declares — fails with an unbound-axis NameError at "
+         "trace time (or silently reduces over the wrong group)",
+         "use the declared mesh axis name (hvd.mesh_axis(), default 'hvd') "
+         "or add the axis to the mesh"),
+    Rule("HVD007", WARNING,
+         "mutation of closed-over Python state inside a traced function — "
+         "happens once at trace time, not per step, and diverges across "
+         "ranks that trace independently",
+         "thread state through function arguments/returns (carry it in the "
+         "step's pytree) instead of mutating captured objects"),
+    Rule("HVD008", ERROR,
+         "wall-clock call (time.time/perf_counter/datetime.now) inside a "
+         "traced function — baked in as a trace-time constant that differs "
+         "per rank and per retrace",
+         "pass timestamps in as arguments, or measure outside the traced "
+         "step"),
+    # -- trace-level (jaxpr) rules -----------------------------------------
+    Rule("HVD100", ERROR,
+         "the step function failed to trace — the jaxpr checker reports the "
+         "exception as a finding instead of crashing the caller",
+         "reproduce with jax.make_jaxpr(step)(*args) and fix the trace "
+         "error"),
+    Rule("HVD101", ERROR,
+         "collective primitive names a mesh axis that is not declared by "
+         "the enclosing mesh/shard_map (the static form of reducing over a "
+         "communicator that does not exist)",
+         "declare the axis on the mesh, or fix the axis_name argument"),
+    Rule("HVD102", WARNING,
+         "lax.cond branches carry different collective signatures "
+         "(primitive/axis/shape/dtype sequence) — if the predicate ever "
+         "diverges across ranks, some ranks issue collectives the others "
+         "never post: the static analogue of Horovod's negotiation "
+         "mismatch",
+         "hoist collectives out of the cond, or make both branches issue "
+         "the identical collective sequence (the unused branch can reduce "
+         "zeros); safe only if the predicate is provably replicated"),
+]}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer finding, renderable as text or JSON."""
+
+    rule: str
+    path: str            # file path, or a logical label for jaxpr findings
+    line: int            # 1-based; 0 for whole-file / whole-program findings
+    col: int
+    message: str
+    severity: str = ""
+    fix_hint: str = ""
+    suppressed: bool = False
+    source: str = "lint"  # "lint" | "jaxpr"
+
+    def __post_init__(self):
+        rule = RULES.get(self.rule)
+        if rule is not None:
+            if not self.severity:
+                self.severity = rule.severity
+            if not self.fix_hint:
+                self.fix_hint = rule.fix_hint
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}" if self.line else self.path
+        sup = " (suppressed)" if self.suppressed else ""
+        return (f"{loc}: {self.rule} [{self.severity}]{sup} {self.message}\n"
+                f"    fix: {self.fix_hint}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def unsuppressed(findings) -> list:
+    return [f for f in findings if not f.suppressed]

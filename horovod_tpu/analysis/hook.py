@@ -1,0 +1,164 @@
+"""HVD_ANALYZE=1 trace-time hook: run the jaxpr checker on first compile.
+
+Opt-in via the environment (``HVD_ANALYZE=1``), wired into the two places
+a step program first becomes visible:
+
+* ``parallel.shard_step`` — analyzes the full shard_map'd step (model +
+  collectives + DistributedOptimizer update) with the first call's
+  concrete arguments, once per wrapper instance/arity/generation;
+* ``DistributedOptimizer`` — analyzes the gradient-reduction program of
+  an *eagerly* driven optimizer (no surrounding shard_step) by tracing
+  its update under the framework axis, once per optimizer
+  instance/generation.
+
+Findings are logged as warnings, the report is appended to
+``core._state.analysis_reports`` (``core.analysis_reports()``), and the
+collective census lands in the active timeline as counter events
+(``Timeline.collective_census``) so the trace viewer shows per-step
+collective counts/bytes next to the op lifecycle.  The hook NEVER raises
+into training code: any analysis failure is logged and swallowed — the
+loudly-but-gracefully contract of the HVD100 rule.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from typing import Any, Optional, Sequence, Tuple
+
+from ..utils import get_logger
+
+_lock = threading.Lock()
+_analyzed: set = set()
+_generation = 0
+_instance_seq = itertools.count(1)  # distinguishes same-named instances
+
+
+def enabled() -> bool:
+    return os.environ.get("HVD_ANALYZE", "") not in ("", "0", "false",
+                                                     "False")
+
+
+def generation() -> int:
+    """Monotonic analysis generation, bumped by ``reset()``.  Integration
+    sites (shard_step, wrap_optimizer) remember the generation at which
+    they analyzed, so an elastic re-init (which calls reset) re-analyzes
+    the programs that recompile in the new world."""
+    return _generation
+
+
+def reset() -> None:
+    """Start a new analysis generation (new world / test isolation).
+    Called by ``core.init`` so every (re)initialized runtime re-analyzes
+    its first compile."""
+    global _generation
+    with _lock:
+        _generation += 1
+        _analyzed.clear()
+
+
+def analyze_traceable(fn, args: Sequence[Any],
+                      kwargs: Optional[dict] = None, *,
+                      label: str,
+                      declared_axes: Optional[Sequence[str]] = None,
+                      axis_env: Optional[Sequence[Tuple[str, int]]] = None,
+                      once: bool = True):
+    """Check ``fn(*args)``; returns the JaxprReport (or None when
+    disabled/already done/failed).  ``once=True`` dedupes globally by
+    ``label``; callers that own their dedup (shard_step's per-wrapper
+    generation tracking, which labels aren't unique enough for) pass
+    ``once=False``.  Safe to call on the hot path."""
+    if not enabled():
+        return None
+    if once:
+        with _lock:
+            if label in _analyzed:
+                return None
+            _analyzed.add(label)
+    log = get_logger()
+    try:
+        from . import jaxpr_check
+        report = jaxpr_check.check_step_fn(
+            fn, args, kwargs, axis_env=axis_env,
+            declared_axes=declared_axes, label=label)
+    except Exception as e:  # never break training over analysis
+        log.warning("HVD_ANALYZE: analysis of %s failed: %s: %s",
+                    label, type(e).__name__, e)
+        return None
+    _publish(report, log)
+    return report
+
+
+def _publish(report, log) -> None:
+    for f in report.findings:
+        log.warning("HVD_ANALYZE: %s", f.format())
+    if report.census:
+        log.info("HVD_ANALYZE: %s collective census: %s%s",
+                 report.label, json.dumps(report.census, sort_keys=True),
+                 f" ({report.dynamic_loops} dynamic loop(s) counted once)"
+                 if report.dynamic_loops else "")
+    try:
+        from .. import core as _core
+        st = _core._state
+        st.analysis_reports.append(report)
+        tl = st.timeline
+        if tl is not None and report.census:
+            tl.collective_census(report.label, report.census)
+    except Exception as e:  # pragma: no cover - publication is best-effort
+        log.warning("HVD_ANALYZE: could not publish report: %s", e)
+
+
+def wrap_optimizer(transformation, label: str = "DistributedOptimizer"):
+    """Wrap an optax GradientTransformation so its first EAGER update
+    triggers a jaxpr check of the equivalent in-trace reduction program.
+
+    In-trace calls (leaves are tracers) are skipped — the surrounding
+    ``shard_step`` hook analyzes the whole step there.  The analyzed
+    program is the update as it compiles under the framework axis
+    (``axis_env=[(mesh_axis, num_slots)]``), i.e. the psum-per-leaf data
+    plane, which is also what the census reports.  Dedup is per wrapped
+    instance + analysis generation (never by ``id()``, which the
+    allocator recycles), so every optimizer gets its own check and an
+    elastic re-init re-checks."""
+    if not enabled():
+        return transformation
+    orig_update = transformation.update
+    tag = f"{label}:{next(_instance_seq)}"
+    done_gen = [None]  # generation at which this instance was analyzed
+
+    def update(updates, state, params=None):
+        if done_gen[0] != generation():
+            if _maybe_analyze_update(orig_update, updates, state, params,
+                                     tag):
+                done_gen[0] = generation()
+        return orig_update(updates, state, params)
+
+    return transformation._replace(update=update)
+
+
+def _maybe_analyze_update(orig_update, updates, state, params,
+                          label: str) -> bool:
+    """Returns True when an analysis actually ran (the caller then stops
+    retrying); False for skip-for-now cases like in-trace calls."""
+    if not enabled():
+        return False
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(updates)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            return False  # in-trace: the shard_step-level hook covers this
+        from .. import core as _core
+        if _core.is_initialized():
+            axis = _core.mesh_axis()
+            size = _core.num_slots()
+        else:
+            axis, size = "hvd", 1
+    except Exception:
+        return False
+    analyze_traceable(
+        lambda g: orig_update(g, state, params)[0], (updates,),
+        label=label, axis_env=[(axis, size)],
+        declared_axes=(axis,), once=False)
+    return True

@@ -1,0 +1,590 @@
+"""AST rules HVD001-HVD008: distributed-training antipatterns.
+
+The rules encode, as source-level patterns, the failure classes the
+reference framework only catches at runtime in the coordinator's
+negotiation phase (controller.cc ComputeResponseList "Mismatched
+allreduce" stalls) or never catches at all (rank-divergent trace
+constants).  ``analyze(tree, path)`` runs every rule over one parsed
+module and returns Findings; suppression comments are applied by the
+linter (linter.py), not here.
+
+Design notes:
+
+* **Traced-function detection** is syntactic: a function is considered
+  traced when it is (a) decorated by a known tracer (``jax.jit``,
+  ``pjit``, ``shard_map``, ``pmap``, ``partial(jax.jit, ...)``), (b)
+  passed by name or as a lambda into a tracer call (``jit(f)``,
+  ``shard_step(f)``, ``lax.scan(body, ...)``), (c) lexically nested
+  inside a traced function, or (d) called by name from inside a traced
+  function (one-module call-graph closure, so ``shard_step(lambda *a:
+  local_step(*a))`` marks ``local_step``).  Cross-module tracing is out
+  of scope — the jaxpr checker (jaxpr_check.py) covers what actually got
+  traced.
+* Rules only ever match syntactically-resolvable names (dotted
+  attribute chains ending in a known collective / RNG / clock name);
+  aliased imports (``from jax.lax import psum as reduce``) are out of
+  scope by design — cheap to evade, but lint is a seatbelt, not a
+  sandbox.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from .findings import Finding
+
+# -- name tables ------------------------------------------------------------
+
+# jax.lax collective primitives (axis-name based).
+LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "pbroadcast",
+}
+
+# horovod-API collectives (engine/negotiation based; no axis argument).
+HVD_COLLECTIVES = {
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
+    "grouped_allreduce_async_",
+    "allgather", "allgather_async", "grouped_allgather",
+    "grouped_allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
+    "barrier", "join",
+    "broadcast_variables", "broadcast_parameters",
+    "broadcast_optimizer_state", "broadcast_object", "allgather_object",
+    "sparse_allreduce", "hierarchical_allreduce", "adasum_allreduce",
+    "sync_batch_stats",
+}
+
+COLLECTIVES = LAX_COLLECTIVES | HVD_COLLECTIVES
+
+# Names that collide with ubiquitous non-collective Python ("".join,
+# os.path.join, Thread.join, lax.broadcast the shape op): these only count
+# as collectives when called bare or through a recognizably hvd-ish base.
+AMBIGUOUS_COLLECTIVES = {"join", "barrier", "broadcast", "broadcast_"}
+HVD_BASES = {"hvd", "horovod_tpu", "ops", "_ops", "collective_ops",
+             "functions", "eager", "engine"}
+
+# Calls that trace the function passed to them.
+TRACER_CALLS = {
+    "jit", "pjit", "pmap", "vmap", "xmap", "shard_map", "shard_step",
+    "make_jaxpr", "eval_shape", "grad", "value_and_grad", "linearize",
+    "vjp", "jvp", "remat", "checkpoint", "scan", "cond", "while_loop",
+    "fori_loop", "switch", "associative_scan", "custom_jvp", "custom_vjp",
+    "named_call",
+}
+
+RANK_NAMES = {"rank", "local_rank", "cross_rank", "process_index",
+              "axis_index"}
+
+STDLIB_RANDOM_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "lognormvariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "triangular", "getrandbits", "randbytes",
+}
+
+NP_RANDOM_SEEDABLE = {"RandomState", "default_rng", "Generator",
+                      "SeedSequence", "PCG64", "Philox"}
+NP_RANDOM_STATE_FNS = {"seed", "get_state", "set_state"}
+
+CLOCK_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns", "process_time",
+             "process_time_ns", "clock_gettime"}
+DATETIME_FNS = {"now", "utcnow", "today"}
+
+# Closed-over-container mutators.  ``.update`` is deliberately absent: it
+# collides with ``optimizer.update(...)`` (optax) in every training step.
+MUTATOR_METHODS = {"append", "extend", "insert", "setdefault", "clear",
+                   "remove", "pop", "popitem", "add", "write",
+                   "writelines", "discard"}
+
+HOST_EFFECT_BARE = {"print", "open", "input", "breakpoint"}
+HOST_EFFECT_DOTTED = {"io_callback", "system", "popen", "run", "call",
+                      "check_output", "check_call", "Popen"}
+HOST_EFFECT_DOTTED_ROOTS = {"os", "subprocess", "io_callback"}
+
+SYNC_METHODS = {"block_until_ready"}
+SYNC_DOTTED = {"device_get"}
+
+
+# -- small AST helpers ------------------------------------------------------
+
+def _dotted(func: ast.AST) -> str:
+    """'jax.lax.psum' for Attribute chains, 'psum' for bare Names, ''
+    when the base is dynamic (call result, subscript)."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _string_consts(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Root Name of an attribute/subscript chain ('cache' for
+    cache['k'].stats)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _chain_attrs(node: ast.AST) -> Set[str]:
+    attrs = set()
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            attrs.add(node.attr)
+        node = node.value
+    return attrs
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_collective_call(call: ast.Call) -> Optional[str]:
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    last = parts[-1]
+    if last not in COLLECTIVES:
+        return None
+    if last in AMBIGUOUS_COLLECTIVES and len(parts) > 1 and \
+            parts[-2] not in HVD_BASES:
+        return None
+    return last
+
+
+def _is_rank_source(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func)
+        return bool(dotted) and dotted.split(".")[-1] in RANK_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in {"rank", "local_rank", "cross_rank",
+                             "process_index"}
+    if isinstance(node, ast.Name):
+        return node.id in {"rank", "local_rank"}
+    return False
+
+
+# -- the analyzer -----------------------------------------------------------
+
+class _Module:
+    """One parsed module plus the derived maps every rule shares."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.funcs_by_name: Dict[str, List[ast.AST]] = {}
+        self.traced: Set[ast.AST] = set()
+        self.declared_axes: Set[str] = set()
+        self._index()
+        self._mark_traced_roots()
+        self._propagate_traced()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Lambda):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.funcs_by_name.setdefault(
+                            tgt.id, []).append(node.value)
+            elif isinstance(node, ast.Call):
+                self._collect_axis_decls(node)
+
+    def _collect_axis_decls(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        last = dotted.split(".")[-1] if dotted else ""
+        if last == "Mesh":
+            for arg in call.args[1:2]:
+                self.declared_axes.update(_string_consts(arg))
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    self.declared_axes.update(_string_consts(kw.value))
+        elif last == "make_mesh":
+            for arg in call.args[:1]:
+                if isinstance(arg, ast.Dict):
+                    for key in arg.keys:
+                        if isinstance(key, ast.Constant) and \
+                                isinstance(key.value, str):
+                            self.declared_axes.add(key.value)
+        elif last in {"P", "PartitionSpec"}:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                self.declared_axes.update(_string_consts(arg))
+        elif last in {"pmap", "shard_step", "xmap"}:
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    self.declared_axes.update(_string_consts(kw.value))
+
+    # -- traced-function marking -------------------------------------------
+
+    def _decorator_traces(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            dotted = _dotted(dec.func)
+            last = dotted.split(".")[-1] if dotted else ""
+            if last in TRACER_CALLS:
+                return True
+            if last == "partial":  # @partial(jax.jit, ...)
+                return any(self._decorator_traces(a) for a in dec.args)
+            return False
+        dotted = _dotted(dec)
+        return bool(dotted) and dotted.split(".")[-1] in TRACER_CALLS
+
+    def _mark_traced_roots(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._decorator_traces(d)
+                       for d in node.decorator_list):
+                    self.traced.add(node)
+            elif isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                last = dotted.split(".")[-1] if dotted else ""
+                if last not in TRACER_CALLS:
+                    continue
+                cands = list(node.args) + [kw.value for kw in node.keywords]
+                for arg in cands:
+                    if isinstance(arg, ast.Lambda):
+                        self.traced.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        for fn in self.funcs_by_name.get(arg.id, ()):
+                            self.traced.add(fn)
+
+    def _own_body(self, fn: ast.AST) -> Iterable[ast.AST]:
+        """Nodes of fn's body, not descending into nested function bodies
+        (those have their own scope; containment handles their tracing)."""
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    yield child  # the def itself, not its body
+                else:
+                    stack.append(child)
+
+    def _propagate_traced(self) -> None:
+        """Close tracing over same-module calls-by-name from traced code."""
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for node in ast.walk(fn if not isinstance(fn, ast.Lambda)
+                                     else fn.body):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name):
+                        for callee in self.funcs_by_name.get(
+                                node.func.id, ()):
+                            if callee not in self.traced:
+                                self.traced.add(callee)
+                                changed = True
+
+    # -- context queries ----------------------------------------------------
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        chain = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                chain.append(cur)
+            cur = self.parents.get(cur)
+        return chain  # innermost first
+
+    def in_traced(self, node: ast.AST) -> bool:
+        if isinstance(node, _FUNC_NODES) and node in self.traced:
+            return True
+        return any(fn in self.traced
+                   for fn in self.enclosing_functions(node))
+
+    def fn_locals(self, fn: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + \
+                list(args.kwonlyargs):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        if isinstance(fn, ast.Lambda):
+            return names
+        for node in self._own_body(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        return names
+
+    def is_closed_over(self, node: ast.AST, root: str) -> bool:
+        """True when ``root`` is not local to any function between ``node``
+        and the outermost traced function enclosing it — i.e. mutation of
+        it from traced code reaches state that outlives the trace."""
+        chain = self.enclosing_functions(node)
+        seen_traced = False
+        for fn in chain:
+            if seen_traced and fn not in self.traced:
+                break  # left the traced region: everything further out is
+                       # state that survives the trace
+            if root in self.fn_locals(fn):
+                return False
+            if fn in self.traced:
+                seen_traced = True
+        return True
+
+
+def analyze(tree: ast.Module, path: str) -> List[Finding]:
+    mod = _Module(tree, path)
+    findings: List[Finding] = []
+
+    def emit(rule: str, node: ast.AST, message: str) -> None:
+        findings.append(Finding(
+            rule=rule, path=path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message))
+
+    _rule_rank_guarded_collective(mod, emit)       # HVD001
+    _rule_swallowed_collective(mod, emit)          # HVD002
+    _rule_traced_body_calls(mod, emit)             # HVD003/4/5/8 + HVD006
+    _rule_closed_over_mutation(mod, emit)          # HVD007
+
+    # Dedup (nested rank-guards can flag one call twice) + stable order.
+    seen, out = set(), []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        key = (f.rule, f.line, f.col)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# -- HVD001: collective under rank-dependent control flow -------------------
+
+def _branch_collectives(branch) -> List[ast.Call]:
+    calls = []
+    for stmt in branch:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _is_collective_call(sub):
+                calls.append(sub)
+    return calls
+
+
+def _rule_rank_guarded_collective(mod: _Module, emit) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If):
+            continue
+        if not any(_is_rank_source(n) for n in ast.walk(node.test)):
+            continue
+        body_calls = _branch_collectives(node.body)
+        else_calls = _branch_collectives(node.orelse)
+        # Symmetric branches — both sides issue the same ordered collective
+        # sequence (e.g. broadcast-as-root vs broadcast-as-receiver) — mean
+        # every rank posts a matching collective: not a deadlock.
+        if body_calls and else_calls and \
+                [_is_collective_call(c) for c in body_calls] == \
+                [_is_collective_call(c) for c in else_calls]:
+            continue
+        for sub in body_calls + else_calls:
+            name = _is_collective_call(sub)
+            emit("HVD001", sub,
+                 f"collective '{name}' is only reached by ranks "
+                 f"satisfying the rank-dependent condition on line "
+                 f"{node.lineno}; the other ranks never post it and the "
+                 f"job deadlocks")
+
+
+# -- HVD002: collective inside exception-swallowing try ---------------------
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    return not any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _rule_swallowed_collective(mod: _Module, emit) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        swallowing = [h for h in node.handlers if _handler_swallows(h)]
+        if not swallowing:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    name = _is_collective_call(sub)
+                    if name:
+                        emit("HVD002", sub,
+                             f"collective '{name}' runs inside a try whose "
+                             f"except (line {swallowing[0].lineno}) swallows "
+                             f"exceptions; a rank that raises skips the "
+                             f"collective while the others block in it")
+
+
+# -- HVD003/004/005/006/008: per-call checks --------------------------------
+
+def _unseeded_random(call: ast.Call, dotted: str) -> Optional[str]:
+    parts = dotted.split(".")
+    last = parts[-1]
+    if parts[0] == "random" and len(parts) == 2 and \
+            last in STDLIB_RANDOM_FNS:
+        return f"stdlib random.{last}() draws from hidden global state"
+    if len(parts) >= 3 and parts[-2] == "random" and \
+            parts[0] in {"np", "numpy"}:
+        if last in NP_RANDOM_SEEDABLE:
+            if not call.args and not call.keywords:
+                return (f"np.random.{last}() without a seed differs per "
+                        f"rank")
+            return None
+        if last in NP_RANDOM_STATE_FNS:
+            return None
+        return f"np.random.{last}() draws from the unseeded global RNG"
+    return None
+
+
+def _host_effect(call: ast.Call, dotted: str) -> Optional[str]:
+    parts = dotted.split(".")
+    last = parts[-1]
+    if len(parts) == 1 and last in HOST_EFFECT_BARE:
+        return f"'{last}' executes on the host at trace time only"
+    if last == "print" and parts[:-1] in (["jax", "debug"], ["debug"]):
+        return None  # jax.debug.print is the sanctioned traced print
+    if last in HOST_EFFECT_DOTTED and \
+            parts[0] in HOST_EFFECT_DOTTED_ROOTS:
+        return f"'{dotted}' is a host side effect inside traced code"
+    if last == "io_callback":
+        return ("'io_callback' adds an ordered host round-trip per step; "
+                "ordered callbacks serialize ranks")
+    return None
+
+
+def _clock_call(dotted: str) -> Optional[str]:
+    parts = dotted.split(".")
+    last = parts[-1]
+    if parts[0] == "time" and len(parts) == 2 and last in CLOCK_FNS:
+        return f"'{dotted}()' is baked in as a trace-time constant"
+    if len(parts) == 1 and last in CLOCK_FNS:
+        return f"'{last}()' is baked in as a trace-time constant"
+    if last in DATETIME_FNS and "datetime" in parts[:-1]:
+        return f"'{dotted}()' is baked in as a trace-time constant"
+    return None
+
+
+def _axis_use(call: ast.Call, last: str) -> List[str]:
+    """String axis names this collective call references."""
+    exprs: List[ast.AST] = []
+    if last in LAX_COLLECTIVES and len(call.args) >= 2:
+        exprs.append(call.args[1])
+    for kw in call.keywords:
+        if kw.arg in {"axis_name", "axis_names"}:
+            exprs.append(kw.value)
+    names: List[str] = []
+    for e in exprs:
+        names.extend(_string_consts(e))
+    return names
+
+
+def _rule_traced_body_calls(mod: _Module, emit) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if not dotted:
+            continue
+        last = dotted.split(".")[-1]
+        traced = mod.in_traced(node)
+
+        # HVD006 applies wherever the call sits: axis literals are
+        # checkable even outside traced code, but only when the file
+        # declares axes at all (otherwise there is nothing to check
+        # against).
+        if last in LAX_COLLECTIVES and mod.declared_axes:
+            for axis in _axis_use(node, last):
+                if axis not in mod.declared_axes:
+                    emit("HVD006", node,
+                         f"collective '{last}' names axis '{axis}' but "
+                         f"this file only declares "
+                         f"{sorted(mod.declared_axes)}")
+
+        if not traced:
+            continue
+        msg = _unseeded_random(node, dotted)
+        if msg:
+            emit("HVD003", node, msg + " inside a traced function")
+        msg = _host_effect(node, dotted)
+        if msg:
+            emit("HVD004", node, msg)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS) or \
+                (last in SYNC_DOTTED):
+            emit("HVD005", node,
+                 f"'{last}' forces a device sync inside the traced step")
+        msg = _clock_call(dotted)
+        if msg:
+            emit("HVD008", node, msg)
+
+
+# -- HVD007: mutation of closed-over state in traced code -------------------
+
+def _rule_closed_over_mutation(mod: _Module, emit) -> None:
+    for fn in mod.traced:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                _check_mutation(mod, node, emit)
+
+
+def _check_mutation(mod: _Module, node: ast.AST, emit) -> None:
+    if isinstance(node, (ast.Global, ast.Nonlocal)):
+        emit("HVD007", node,
+             f"'{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+             f"{', '.join(node.names)}' rebinds outer state from traced "
+             f"code; the write happens once at trace time, not per step")
+        return
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in MUTATOR_METHODS:
+        root = _root_name(node.func.value)
+        if root and "at" not in _chain_attrs(node.func.value) and \
+                mod.is_closed_over(node, root):
+            emit("HVD007", node,
+                 f"'{root}.{node.func.attr}(...)' mutates closed-over "
+                 f"'{root}' from traced code (trace-time effect, not a "
+                 f"per-step one)")
+        return
+    for tgt in targets:
+        if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            root = _root_name(tgt)
+            if root and "at" not in _chain_attrs(tgt) and \
+                    mod.is_closed_over(node, root):
+                emit("HVD007", tgt,
+                     f"assignment into closed-over '{root}' from traced "
+                     f"code happens at trace time, not per step, and "
+                     f"diverges across independently-tracing ranks")

@@ -45,6 +45,10 @@ class _GlobalState:
         self.timeline = None
         self.param_manager = None
         self.elastic_enabled = False
+        # JaxprReports published by the HVD_ANALYZE=1 trace-time hook
+        # (analysis/hook.py); read via core.analysis_reports().  Survives
+        # shutdown so post-run tooling (bench.py) can still read it.
+        self.analysis_reports: List = []
 
 
 _state = _GlobalState()
@@ -156,6 +160,13 @@ def init(comm: Optional[Sequence[int]] = None,
     with _state.lock:
         if _state.initialized:
             return
+        from .analysis import hook as _analysis_hook
+        if _analysis_hook.enabled():
+            # Fresh world ⇒ fresh first-compile analysis generation: an
+            # elastic re-init compiles new programs that deserve their own
+            # check (analysis/hook.py generation()).
+            _analysis_hook.reset()
+            _state.analysis_reports = []
         cfg = _config.Config.from_env()
         if cfg.compilation_cache_dir:
             # Persistent XLA compilation cache: elastic world resizes and
@@ -304,6 +315,13 @@ def _require_init() -> _GlobalState:
 def is_initialized() -> bool:
     """horovod_is_initialized (operations.cc)."""
     return _state.initialized
+
+
+def analysis_reports() -> list:
+    """JaxprReports from the HVD_ANALYZE=1 trace-time checker (newest
+    last).  Empty unless HVD_ANALYZE was set when step programs first
+    compiled; see docs/static_analysis.md."""
+    return list(_state.analysis_reports)
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

@@ -391,8 +391,18 @@ def DistributedOptimizer(optimizer,
         process_set=process_set, groups=groups, reduce_axes=reduce_axes)
     n = max(1, int(backward_passes_per_step))
 
+    def _maybe_analyzed(t):
+        # HVD_ANALYZE=1: the first eager update runs the jaxpr collective-
+        # consistency checker over this optimizer's reduction program and
+        # publishes its collective census (analysis/hook.py).  In-trace
+        # updates are covered by the shard_step-level hook instead.
+        from .analysis import hook as _analysis_hook
+        if _analysis_hook.enabled():
+            return _analysis_hook.wrap_optimizer(t)
+        return t
+
     if n == 1:
-        return optax.chain(allreduce_t, optimizer)
+        return _maybe_analyzed(optax.chain(allreduce_t, optimizer))
 
     def init_fn(params):
         return DistributedState(
@@ -468,7 +478,7 @@ def DistributedOptimizer(optimizer,
         new_counter = jnp.where(sync, 0, counter)
         return new_updates, DistributedState(new_inner, new_acc, new_counter)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    return _maybe_analyzed(optax.GradientTransformation(init_fn, update_fn))
 
 
 def PartialDistributedOptimizer(optimizer,

@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import core as _core
+from ..analysis import hook as _analysis_hook
 from ..ops.collective_ops import hierarchical_allreduce  # noqa: F401
 
 
@@ -89,9 +90,10 @@ def shard_step(fn: Callable,
         # varying) return through replicated out_specs.
         mapped = jax.shard_map(fn, mesh=mesh, in_specs=ins, out_specs=outs,
                                check_vma=check_vma)
-        return jax.jit(mapped, donate_argnums=donate_argnums)
+        return jax.jit(mapped, donate_argnums=donate_argnums), mapped
 
     cache = {}
+    analyzed_gen = {}  # arity -> analysis generation it was checked in
 
     def wrapper(*args, **kwargs):
         if kwargs:
@@ -102,7 +104,23 @@ def shard_step(fn: Callable,
         key = len(args)
         if key not in cache:
             cache[key] = build(key)
-        return cache[key](*args)
+        jitted, mapped = cache[key]
+        if _analysis_hook.enabled() and \
+                analyzed_gen.get(key) != _analysis_hook.generation():
+            # Trace-time correctness check on first compile (HVD_ANALYZE=1,
+            # analysis/hook.py): runs the jaxpr collective-consistency
+            # checker over the un-donated shard_map program with this
+            # call's concrete args, BEFORE the jitted call may consume
+            # donated buffers.  Deduped per wrapper instance + arity +
+            # analysis generation (NOT by function name, which two distinct
+            # steps can share); an elastic re-init bumps the generation and
+            # re-checks.  Never raises.
+            analyzed_gen[key] = _analysis_hook.generation()
+            _analysis_hook.analyze_traceable(
+                mapped, args,
+                label=f"shard_step:{getattr(fn, '__name__', 'fn')}/{key}",
+                declared_axes=tuple(mesh.axis_names), once=False)
+        return jitted(*args)
 
     return wrapper
 

@@ -45,6 +45,14 @@ RING_HOP = "RING_HOP"
 RING_KERNEL = "RING_KERNEL"
 RING_TRANSFER = "RING_TRANSFER"
 
+# Static per-step collective census (no reference analog — the reference
+# only learns the collective set at runtime through negotiation; on TPU
+# the jaxpr checker reads it off the traced program, analysis/
+# jaxpr_check.py).  Rendered as Chrome-trace counter events so the
+# viewer charts collective count/bytes per primitive next to the op
+# lifecycle.
+COLLECTIVE_CENSUS = "COLLECTIVE_CENSUS"
+
 
 class Timeline:
     """Chrome-trace writer with a background writer thread
@@ -141,6 +149,18 @@ class Timeline:
         self._put({"name": f"{kind}_{hop}", "ph": "X", "ts": start_us,
                    "dur": dur_us, "pid": self.rank, "tid": tensor_name,
                    "args": dict(args, hop=hop)})
+
+    def collective_census(self, step_name: str, census: dict):
+        """Per-step collective census from the jaxpr checker
+        (HVD_ANALYZE=1, analysis/hook.py): ``census`` maps primitive name
+        → {"count", "bytes"}.  One counter event per primitive —
+        count/bytes chart as stacked counters in the trace viewer."""
+        for prim in sorted(census):
+            info = census[prim]
+            self._put({"name": f"{COLLECTIVE_CENSUS}/{step_name}/{prim}",
+                       "ph": "C", "ts": self._ts_us(), "pid": self.rank,
+                       "args": {"count": int(info.get("count", 0)),
+                                "bytes": int(info.get("bytes", 0))}})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,

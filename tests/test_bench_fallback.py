@@ -153,7 +153,8 @@ def test_fresh_capture_supersedes_stale(tmp_path):
         json.dump(dict(_FAKE_RECORD, value=99.9), f)
     env = _bench_env(tag, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
                      BENCH_PROBE_BUDGET_S="60",
-                     BENCH_PROBE_TIMEOUT_S="30")
+                     BENCH_PROBE_TIMEOUT_S="30",
+                     HVD_ANALYZE="1")
     try:
         r = subprocess.run([sys.executable, _BENCH], env=env,
                            capture_output=True, text=True, timeout=420)
@@ -165,6 +166,13 @@ def test_fresh_capture_supersedes_stale(tmp_path):
         assert "stale" not in last                 # superseded by fresh
         assert last["metric"] == "resnet50_synthetic_images_per_sec"
         assert "SMOKE" in last["config"]
+        # HVD_ANALYZE=1 rode along: the shard_step hook checked the step
+        # program on first compile and bench surfaced its collective
+        # census (count + payload bytes per primitive) in the record.
+        census = last["collective_census"]
+        assert census["psum"]["count"] >= 1
+        assert census["psum"]["bytes"] > 0
+        assert last["analysis_findings"] == 0
         with open(path) as f:
             persisted = json.load(f)
         assert persisted["value"] == last["value"]  # persisted for next time

@@ -1,0 +1,499 @@
+"""hvdlint AST linter: seeded violation corpus + clean fixtures + CLI.
+
+Every HVD rule must fire exactly where the corpus plants it (rule, line)
+and must NOT fire on the adjacent clean fixture — the acceptance bar for
+the analyzer ("no false positives on the clean fixtures").  The CLI
+contract (text/JSON output, exit codes, suppression pragmas, graceful
+syntax-error handling) is exercised end to end in-process.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import (Finding, RULES, lint_paths, lint_source,
+                                  unsuppressed)
+from horovod_tpu.analysis.cli import main as cli_main
+
+
+def findings_of(src, **kw):
+    return lint_source(textwrap.dedent(src), path="corpus.py", **kw)
+
+
+def fired(src, **kw):
+    return [(f.rule, f.line) for f in findings_of(src, **kw)
+            if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# Violation corpus: one seeded violation per rule, asserted by (rule, line).
+# ---------------------------------------------------------------------------
+
+def test_hvd001_rank_guarded_collective():
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(p):
+        if hvd.rank() == 0:
+            p = hvd.broadcast_variables(p, root_rank=0)
+        return p
+    """
+    assert fired(src) == [("HVD001", 5)]
+
+
+def test_hvd001_bare_rank_variable_and_else_branch():
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(x, rank):
+        if rank == 0:
+            pass
+        else:
+            x = hvd.allreduce(x)
+        return x
+    """
+    assert fired(src) == [("HVD001", 7)]
+
+
+def test_hvd001_symmetric_branches_are_not_a_deadlock():
+    """Identical collective sequences on both sides of a rank test mean
+    every rank posts a matching collective (review regression)."""
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(x, buf):
+        if hvd.rank() == 0:
+            x = hvd.broadcast(x, root_rank=0)
+        else:
+            buf = hvd.broadcast(buf, root_rank=0)
+        return x, buf
+    """
+    assert fired(src) == []
+    # Asymmetric sequences still fire on both branches' collectives.
+    asym = """\
+    import horovod_tpu as hvd
+
+    def main(x):
+        if hvd.rank() == 0:
+            x = hvd.allreduce(x)
+        else:
+            x = hvd.allgather(x)
+        return x
+    """
+    assert fired(asym) == [("HVD001", 5), ("HVD001", 7)]
+
+
+def test_hvd001_clean_rank_guarded_print_and_unguarded_collective():
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(x):
+        x = hvd.allreduce(x)
+        if hvd.rank() == 0:
+            print("loss", x)
+        return x
+    """
+    assert fired(src) == []
+
+
+def test_hvd002_swallowed_collective():
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(x):
+        try:
+            x = hvd.allreduce(x)
+        except Exception:
+            x = None
+        return x
+    """
+    assert fired(src) == [("HVD002", 5)]
+
+
+def test_hvd002_clean_reraising_handler():
+    src = """\
+    import horovod_tpu as hvd
+
+    def main(x):
+        try:
+            x = hvd.allreduce(x)
+        except Exception:
+            raise RuntimeError("rank failed") from None
+        return x
+    """
+    assert fired(src) == []
+
+
+def test_hvd003_unseeded_randomness_in_traced_fn():
+    src = """\
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        return x * np.random.rand()
+    """
+    assert fired(src) == [("HVD003", 6)]
+
+
+def test_hvd003_traced_via_call_argument_and_propagation():
+    src = """\
+    import jax
+    import random
+
+    def helper(x):
+        return x + random.random()
+
+    def step(x):
+        return helper(x)
+
+    step = jax.jit(step)
+    """
+    assert fired(src) == [("HVD003", 5)]
+
+
+def test_hvd003_clean_seeded_and_untraced():
+    src = """\
+    import jax
+    import numpy as np
+
+    def host_data():
+        return np.random.rand(8)          # not traced: fine
+
+    @jax.jit
+    def step(x, key):
+        rng = np.random.RandomState(0)    # seeded: fine
+        return x + jax.random.normal(key, x.shape)
+    """
+    assert fired(src) == []
+
+
+def test_hvd004_print_in_traced_fn_and_clean_debug_print():
+    src = """\
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("tracing", x)
+        jax.debug.print("x={x}", x=x)
+        return x
+    """
+    assert fired(src) == [("HVD004", 5)]
+
+
+def test_hvd005_block_until_ready_in_traced_fn():
+    src = """\
+    import jax
+
+    @jax.jit
+    def step(x):
+        y = (x * 2).block_until_ready()
+        return jax.device_get(y)
+    """
+    assert fired(src) == [("HVD005", 5), ("HVD005", 6)]
+
+
+def test_hvd006_undeclared_axis_literal():
+    src = """\
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh([], ("dp",))
+
+    def f(x):
+        return jax.lax.psum(x, "tp")
+    """
+    assert fired(src) == [("HVD006", 7)]
+
+
+def test_hvd006_clean_declared_axis_and_no_declarations():
+    clean = """\
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh([], ("dp",))
+
+    def f(x):
+        return jax.lax.psum(x, "dp")
+    """
+    assert fired(clean) == []
+    # No declarations in the file -> nothing to check against.
+    no_decl = """\
+    import jax
+
+    def f(x):
+        return jax.lax.psum(x, "whatever")
+    """
+    assert fired(no_decl) == []
+
+
+def test_hvd007_closed_over_mutation():
+    src = """\
+    import jax
+
+    cache = {}
+
+    @jax.jit
+    def step(x):
+        cache["x"] = x
+        return x
+    """
+    assert fired(src) == [("HVD007", 7)]
+
+
+def test_hvd007_factory_local_is_still_closed_over_for_the_trace():
+    src = """\
+    import jax
+
+    def make_step():
+        seen = []
+
+        @jax.jit
+        def step(x):
+            seen.append(x)
+            return x
+
+        return step
+    """
+    assert fired(src) == [("HVD007", 8)]
+
+
+def test_hvd007_clean_local_mutation_and_functional_update():
+    src = """\
+    import jax
+
+    @jax.jit
+    def step(x, buf):
+        local = {}
+        local["x"] = x              # local: fine
+        buf = buf.at[0].add(x)      # functional update: fine
+        return x, buf
+    """
+    assert fired(src) == []
+
+
+def test_hvd008_wall_clock_in_traced_fn():
+    src = """\
+    import jax
+    import time
+
+    @jax.jit
+    def step(x):
+        return x + time.time()
+    """
+    assert fired(src) == [("HVD008", 6)]
+
+
+def test_hvd008_clean_untraced_timing():
+    src = """\
+    import time
+
+    def bench(step, x):
+        t0 = time.perf_counter()
+        step(x)
+        return time.perf_counter() - t0
+    """
+    assert fired(src) == []
+
+
+def test_join_collective_requires_hvd_base():
+    """os.path.join / ','.join / thread.join must not read as the hvd.join
+    collective (the false positives the first dogfooding run surfaced)."""
+    src = """\
+    import os
+    import horovod_tpu as hvd
+
+    def main(rank, t):
+        if rank == 0:
+            p = os.path.join("a", "b")
+            s = ",".join(["x"])
+            t.join()
+        try:
+            q = os.path.join("c", "d")
+        except Exception:
+            pass
+        return p, s, q
+    """
+    assert fired(src) == []
+    guarded = """\
+    import horovod_tpu as hvd
+
+    def main(rank):
+        if rank == 0:
+            hvd.join()
+    """
+    assert fired(guarded) == [("HVD001", 5)]
+
+
+# ---------------------------------------------------------------------------
+# Suppression, degradation, filters
+# ---------------------------------------------------------------------------
+
+def test_line_suppression_only_silences_named_rule():
+    src = """\
+    import jax
+    import time
+
+    @jax.jit
+    def step(x):
+        t = time.time()  # hvdlint: disable=HVD008
+        print(t)  # hvdlint: disable=HVD004
+        return x + time.perf_counter()
+    """
+    fs = findings_of(src)
+    assert [(f.rule, f.line) for f in fs if f.suppressed] == \
+        [("HVD008", 6), ("HVD004", 7)]
+    assert [(f.rule, f.line) for f in fs if not f.suppressed] == \
+        [("HVD008", 8)]
+
+
+def test_pragma_in_string_literal_does_not_suppress():
+    """Pragma-shaped text inside strings/docstrings must not silence the
+    linter (review regression: line-regex scanning matched strings)."""
+    src = '''\
+    import jax
+    import time
+
+    DOC = "to silence a rule, write  # hvdlint: disable-file=all  ..."
+
+    @jax.jit
+    def step(x):
+        """Help: use '# hvdlint: disable=HVD008' on the flagged line."""
+        return x + time.time()
+    '''
+    assert fired(src) == [("HVD008", 9)]
+
+
+def test_file_suppression_and_disable_all():
+    src = """\
+    # hvdlint: disable-file=HVD004
+    import jax
+    import time
+
+    @jax.jit
+    def step(x):
+        print(x)
+        t = time.time()  # hvdlint: disable=all
+        return x
+    """
+    assert fired(src) == []
+    assert len(findings_of(src)) == 2  # both still reported, suppressed
+
+
+def test_syntax_error_becomes_hvd000_finding():
+    fs = lint_source("def broken(:\n    pass\n", path="bad.py")
+    assert [f.rule for f in fs] == ["HVD000"]
+    assert "could not parse" in fs[0].message
+    assert fs[0].severity == "error"
+
+
+def test_hvd000_respects_select_and_ignore():
+    """Parse failures obey the rule filters like any other rule (review
+    regression: HVD000 used to bypass --select/--ignore)."""
+    bad = "def broken(:\n"
+    assert lint_source(bad, select=("HVD001",)) == []
+    assert lint_source(bad, ignore=("HVD000",)) == []
+    assert [f.rule for f in lint_source(bad, select=("HVD000",))] == \
+        ["HVD000"]
+    from horovod_tpu.analysis import lint_paths
+    assert lint_paths(["/nonexistent/x"], ignore=("HVD000",)) == []
+
+
+def test_select_and_ignore_filters():
+    src = """\
+    import jax
+    import time
+
+    @jax.jit
+    def step(x):
+        print(x)
+        return x + time.time()
+    """
+    assert fired(src, select=("HVD008",)) == [("HVD008", 7)]
+    assert fired(src, ignore=("HVD008",)) == [("HVD004", 6)]
+
+
+def test_every_finding_carries_catalogue_metadata():
+    src = """\
+    import jax
+    import time
+
+    @jax.jit
+    def step(x):
+        return x + time.time()
+    """
+    (f,) = findings_of(src)
+    assert f.severity == RULES[f.rule].severity
+    assert f.fix_hint == RULES[f.rule].fix_hint
+    assert f.to_dict()["rule"] == f.rule
+
+
+# ---------------------------------------------------------------------------
+# CLI + path walking
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def corpus_dir(tmp_path):
+    (tmp_path / "dirty.py").write_text(textwrap.dedent("""\
+        import jax
+        import time
+
+        @jax.jit
+        def step(x):
+            return x + time.time()
+        """))
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    sub = tmp_path / "__pycache__"
+    sub.mkdir()
+    (sub / "skipme.py").write_text("def broken(:\n")
+    return tmp_path
+
+
+def test_cli_text_output_and_exit_codes(corpus_dir, capsys):
+    rc = cli_main([str(corpus_dir)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD008" in out and "dirty.py" in out
+    assert "skipme" not in out  # __pycache__ pruned
+    rc = cli_main([str(corpus_dir / "clean.py")])
+    assert rc == 0
+
+
+def test_cli_json_output(corpus_dir, capsys):
+    rc = cli_main([str(corpus_dir), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["total"] == 1
+    assert payload["summary"]["by_rule"] == {"HVD008": 1}
+    (f,) = payload["findings"]
+    assert f["rule"] == "HVD008" and f["line"] == 6
+
+
+def test_cli_missing_path_is_a_finding_not_a_crash(capsys):
+    rc = cli_main(["/nonexistent/hvdlint/path"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD000" in out and "does not exist" in out
+
+
+def test_cli_syntax_error_file_nonzero_but_graceful(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = cli_main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD000" in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_lint_paths_mixed_file_and_dir(corpus_dir):
+    fs = lint_paths([str(corpus_dir / "dirty.py"), str(corpus_dir)])
+    # deduped: dirty.py linted once even though passed twice
+    assert [(f.rule, f.line) for f in unsuppressed(fs)] == [("HVD008", 6)]

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""hvdlint shim: lint without installing the package.
+
+``python tools/hvdlint.py horovod_tpu examples`` from the repo root is
+the single command the verify recipe / CI calls; it exits nonzero on any
+unsuppressed finding (same contract as ``python -m horovod_tpu.analysis``
+and the ``hvdlint`` console script — see docs/static_analysis.md).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
