@@ -42,7 +42,9 @@ BASELINE_IMG_S_PER_DEV = 1656.82 / 16  # docs/benchmarks.rst:40-42
 # bench_bert/bench_gpt2 AND by _last_good_path's keying (a divergent copy
 # would let an ablation run clobber the driver's default fallback record).
 KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
-                 "BENCH_BERT_MLMPOS": "20", "BENCH_GPT2_BATCH": "8"}
+                 "BENCH_BERT_MLMPOS": "20", "BENCH_GPT2_BATCH": "8",
+                 "BENCH_SERVE_REQUESTS": "64", "BENCH_SERVE_NEWTOKENS": "32",
+                 "BENCH_SERVE_REPLICAS": "2"}
 
 
 def _last_good_path():
@@ -300,6 +302,100 @@ def bench_ring():
     })
 
 
+def bench_serve():
+    """BENCH_MODEL=serve: continuous-batching serving microbench
+    (horovod_tpu/serve, docs/serving.md).
+
+    Stands up the replica scheduler over process sets, floods it with
+    concurrent generation requests through the real batcher/engine path
+    (HTTP is exercised by tests/test_serve_e2e.py; the bench measures the
+    decode plane), and reports aggregate tokens/sec with the latency
+    split the serving literature standardizes on: TTFT (prefill wait +
+    compute) and per-output-token step latency, plus achieved batch
+    occupancy — the continuous-batching statistic (occupancy ~1 would
+    mean the engine degenerated into request-level batching)."""
+    import threading
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    from horovod_tpu.serve import (Request, ServeMetrics,
+                                   TransformerAdapter, build_replicas)
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    KNOB_DEFAULTS["BENCH_SERVE_REQUESTS"]))
+    new_tokens = int(os.environ.get("BENCH_SERVE_NEWTOKENS",
+                                    KNOB_DEFAULTS["BENCH_SERVE_NEWTOKENS"]))
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS",
+                                  KNOB_DEFAULTS["BENCH_SERVE_REPLICAS"]))
+    if smoke:
+        n_requests, new_tokens = min(n_requests, 16), min(new_tokens, 8)
+    cfg = TransformerConfig(
+        vocab_size=256, causal=True, dtype=jnp.float32, scan_layers=False,
+        **({"num_layers": 2, "num_heads": 2, "d_model": 64, "d_ff": 128,
+            "max_len": 64} if smoke else
+           {"num_layers": 4, "num_heads": 4, "d_model": 256, "d_ff": 1024,
+            "max_len": 256}))
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompts = [rng.randint(0, 256, size=(int(rng.randint(4, 24)),)).tolist()
+               for _ in range(n_requests)]
+    # One adapter per replica, SHARED across the warm and measured
+    # schedulers: their prefill/decode compile caches live on the adapter,
+    # so running the identical storm once first compiles every (count,
+    # prompt-length) bucket the workload can hit — a single warm request
+    # would leave most buckets to compile inside the timed window.
+    adapters = [TransformerAdapter(cfg, params) for _ in range(replicas)]
+
+    def run_storm(sched):
+        requests = [Request(p, max_new_tokens=new_tokens) for p in prompts]
+        for r in requests:
+            sched.submit(r)
+        return [r.result(timeout=600) for r in requests]
+
+    it = iter(adapters)
+    warm_sched = build_replicas(lambda: next(it), num_replicas=replicas,
+                                metrics=ServeMetrics())
+    warm_sched.start()
+    run_storm(warm_sched)
+    warm_sched.stop()
+
+    metrics = ServeMetrics()
+    from horovod_tpu import core as _core
+    if _core._state.timeline is not None:
+        metrics.set_timeline(_core._state.timeline)
+    it = iter(adapters)
+    sched = build_replicas(lambda: next(it), num_replicas=replicas,
+                           metrics=metrics)
+    sched.start()
+    metrics.started_at = time.monotonic()
+    t0 = time.perf_counter()
+    outs = run_storm(sched)
+    dt = time.perf_counter() - t0
+    sched.stop()
+    total_tokens = sum(len(o) for o in outs)
+    snap = metrics.snapshot()
+    _emit({
+        "metric": "serve_tokens_per_sec",
+        "value": round(total_tokens / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(total_tokens / dt / hvd.num_slots(), 3),
+        "config": f"{replicas} replica(s) x batch "
+                  f"{os.environ.get('HVD_SERVE_MAX_BATCH', '8')}, "
+                  f"{n_requests} reqs x {new_tokens} tokens, "
+                  f"L{cfg.num_layers} d{cfg.d_model} greedy f32"
+                  + (" SMOKE" if smoke else ""),
+        "ttft_p50_ms": snap["ttft"]["p50_ms"],
+        "ttft_p99_ms": snap["ttft"]["p99_ms"],
+        "token_step_p50_ms": snap["token_step"]["p50_ms"],
+        "token_step_p99_ms": snap["token_step"]["p99_ms"],
+        "occupancy_mean": snap["occupancy"]["mean"],
+        "occupancy_max": snap["occupancy"]["max"],
+        "requests": snap["requests"],
+    })
+
+
 def _wait_for_devices(have_stale):
     """The one-chip relay can report UNAVAILABLE **or hang outright** in
     jax.devices(); an in-process retry loop never fires on the hang.  Probe
@@ -383,6 +479,10 @@ def main():
     if os.environ.get("BENCH_MODEL", "") == "ring":
         hvd.init()
         bench_ring()
+        return
+    if os.environ.get("BENCH_MODEL", "") == "serve":
+        hvd.init()
+        bench_serve()
         return
     hvd.init()
     nslots = hvd.num_slots()

@@ -70,12 +70,13 @@ from .parallel import shard_step  # noqa: F401  (hvd.shard_step idiom)
 
 from . import runner  # noqa: F401
 from . import elastic  # noqa: F401
+from . import serve  # noqa: F401  (continuous-batching inference serving)
 from . import spark  # noqa: F401
 run = runner.run  # launcher API (reference: horovod.run, runner/__init__.py:95)
 
 from .process_sets import (  # noqa: F401
     ProcessSet, global_process_set, add_process_set, remove_process_set,
-    get_process_set_ids,
+    get_process_set_ids, partition_process_sets,
 )
 
 from .exceptions import (  # noqa: F401

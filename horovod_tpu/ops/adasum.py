@@ -25,7 +25,11 @@ butterfly neighbors are physical torus neighbors, which is what
 
 Numerics: dot/norm accumulation runs in float32 islands regardless of input
 dtype, the bf16-world analog of the reference computing them in double
-(adasum.h:357-363).  Validated against a NumPy model of the reference
+(adasum.h:357-363).  ``HVD_ADASUM_ACC_DTYPE=f64`` widens the islands to the
+reference's actual double precision (requires jax x64; requesting f64
+without it warns and keeps f32 rather than silently computing f32 under an
+f64 label).  The knob is read at TRACE time — programs compiled before a
+change keep their dtype.  Validated against a NumPy model of the reference
 recursion in tests/test_adasum.py (mirrors test/parallel/test_adasum_*.py).
 
 Non-power-of-two participant counts fall back to an all_gather + local tree
@@ -34,9 +38,35 @@ with zero-padded virtual ranks (``adasum(a, 0) = a``), preserving the math.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils import get_logger
+
+_warned_no_x64 = False
+
+
+def _acc_dtype():
+    """Accumulation dtype for the dot/norm islands (module docstring:
+    HVD_ADASUM_ACC_DTYPE, default f32, reference uses f64)."""
+    global _warned_no_x64
+    name = os.environ.get("HVD_ADASUM_ACC_DTYPE", "f32")
+    if name in ("f32", "float32"):
+        return jnp.float32
+    if name in ("f64", "float64"):
+        if jax.config.jax_enable_x64:
+            return jnp.float64
+        if not _warned_no_x64:
+            _warned_no_x64 = True
+            get_logger().warning(
+                "HVD_ADASUM_ACC_DTYPE=f64 requested but jax x64 is "
+                "disabled (jax_enable_x64); keeping f32 islands")
+        return jnp.float32
+    raise ValueError(
+        f"HVD_ADASUM_ACC_DTYPE={name!r}: expected 'f32' or 'f64'")
 
 
 def _coefficients(a32: jax.Array, b32: jax.Array,
@@ -65,9 +95,11 @@ def _coefficients(a32: jax.Array, b32: jax.Array,
 
 def pair_combine(a: jax.Array, b: jax.Array,
                  per_slice_axis0: bool = False) -> jax.Array:
-    """Adasum of one pair, f32 accumulation island."""
-    a32 = a.astype(jnp.float32)
-    b32 = b.astype(jnp.float32)
+    """Adasum of one pair; accumulation island dtype per ``_acc_dtype``
+    (f32 default, HVD_ADASUM_ACC_DTYPE=f64 for reference-parity double)."""
+    acc = _acc_dtype()
+    a32 = a.astype(acc)
+    b32 = b.astype(acc)
     acoeff, bcoeff = _coefficients(a32, b32, per_slice_axis0)
     return (acoeff * a32 + bcoeff * b32).astype(a.dtype)
 

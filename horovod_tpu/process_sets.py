@@ -197,3 +197,28 @@ def process_set_included(process_set_id: int = 0) -> bool:
 
 def get_process_set_ids() -> List[int]:
     return sorted(_table().table.keys())
+
+
+def partition_process_sets(num_groups: int) -> List[ProcessSet]:
+    """Register ``num_groups`` disjoint contiguous process sets covering
+    every slot (TPU extension; no reference analog — the reference has no
+    built-in partitioner).  Slots are dealt contiguously so each group's
+    members are ICI torus neighbors; a ragged remainder is spread one
+    slot at a time over the leading groups.  A single group spans the
+    full axis and lowers to the un-grouped fast path (members() → None).
+
+    Primary consumer: ``serve.replica.build_replicas`` maps independent
+    serving replicas onto the groups; also a convenient way to build
+    hierarchical-collective islands.
+    """
+    n = _core.num_slots()
+    if num_groups < 1 or num_groups > n:
+        raise ValueError(
+            f"cannot partition {n} slots into {num_groups} groups")
+    base, extra = divmod(n, num_groups)
+    sets, start = [], 0
+    for g in range(num_groups):
+        width = base + (1 if g < extra else 0)
+        sets.append(add_process_set(list(range(start, start + width))))
+        start += width
+    return sets

@@ -1,0 +1,40 @@
+"""horovod_tpu.serve — continuous-batching inference serving over the
+data-parallel mesh.
+
+The training stack (ring/flash attention, elastic, autotune, hvdlint)
+ends at the optimizer step; this subsystem opens the serving workload on
+the same machinery: compiled step functions (per-bucket prefill + one
+decode program), ``process_sets`` replica groups, ``elastic/preemption``
+rank-loss reports, and ``timeline`` counters.
+
+Layers (docs/serving.md has the architecture):
+
+* :mod:`engine`  — slot-based KV cache + iteration-level decode loop;
+* :mod:`batcher` — bounded queue, size/deadline triggers, shape buckets;
+* :mod:`replica` — process-set replicas, least-loaded routing, failover;
+* :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
+  ``hvdserve`` CLI;
+* :mod:`metrics` — TTFT / per-token histograms, occupancy, tokens/s.
+
+Quickstart (CPU-exercisable end to end)::
+
+    import horovod_tpu as hvd
+    from horovod_tpu.serve import build_replicas, ServeServer
+    hvd.init()
+    sched = build_replicas(make_adapter, num_replicas=2)
+    port = ServeServer(sched).start(port=8000)
+    # curl -d '{"tokens": [1,2,3], "max_new_tokens": 8}' :8000/generate
+"""
+
+from .batcher import (  # noqa: F401
+    DeadlineExceededError, DynamicBatcher, QueueFullError, Request,
+    bucket_requests, prompt_bucket,
+)
+from .engine import (  # noqa: F401
+    InferenceEngine, MLPAdapter, ModelAdapter, TransformerAdapter,
+)
+from .metrics import Histogram, ServeMetrics  # noqa: F401
+from .replica import (  # noqa: F401
+    NoHealthyReplicaError, Replica, ReplicaScheduler, build_replicas,
+)
+from .server import ServeServer, run_commandline  # noqa: F401

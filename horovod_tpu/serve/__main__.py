@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.serve`` — the ``hvdserve`` console entry."""
+
+import sys
+
+from .server import run_commandline
+
+sys.exit(run_commandline())

@@ -1,0 +1,248 @@
+"""Dynamic request batcher: bounded queue, size/deadline admission
+triggers, shape bucketing, explicit backpressure.
+
+No reference analog (the reference is training-only).  The design follows
+the serving literature: admission happens at *token-step* granularity
+(Orca's iteration-level scheduling) — the engine polls ``get_admission``
+between decode steps, so a request never waits for a whole running batch
+to finish — and the queue is bounded with EXPLICIT shedding (an unbounded
+queue converts overload into unbounded latency; a 503 at admission keeps
+tail latency honest and lets the client retry against another front-end).
+
+Triggers:
+
+* **size** — enough queued requests to fill the engine's free slots: admit
+  immediately (a fuller batch costs nothing extra per Orca's argument —
+  the decode step is memory-bound on batch-1 anyway);
+* **deadline** — the oldest queued request has waited
+  ``HVD_SERVE_MAX_WAIT_MS``: admit whatever is there (bounds the latency
+  cost of batch formation when traffic is sparse).
+
+Shape bucketing: prompt lengths are padded up to power-of-two buckets
+(floor ``HVD_SERVE_BUCKET_MIN``) so the engine compiles one prefill per
+bucket instead of one per length — ``bucket_requests`` groups an admitted
+set by bucket and the engine runs one prefill per group.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class QueueFullError(Exception):
+    """Backpressure: the bounded queue is at capacity — shed the request
+    (HTTP 503 at the front-end) instead of queueing unbounded latency."""
+
+
+class DeadlineExceededError(Exception):
+    """The request's client-supplied deadline expired while queued."""
+
+
+class _Counter:
+    lock = threading.Lock()
+    n = 0
+
+    @classmethod
+    def next(cls) -> int:
+        with cls.lock:
+            cls.n += 1
+            return cls.n
+
+
+class Request:
+    """One generation request travelling batcher → engine → completion.
+
+    Completion is a per-request event: HTTP handler threads block in
+    ``result()`` while engine threads call ``complete``/``fail``.  A
+    request drained off a dead replica is *resubmitted* — generated
+    tokens are discarded and it restarts cleanly elsewhere; greedy
+    decoding makes the eventual answer identical (tests pin this).
+    """
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 request_id: Optional[str] = None):
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            # Prefill always produces one token; a request for zero would
+            # silently be answered with one (and pay the prefill anyway).
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.request_id = request_id or f"req-{_Counter.next()}"
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + timeout_s
+                         if timeout_s else None)
+        self.generated: List[int] = []
+        self.replica_id: Optional[str] = None
+        self.requeues = 0
+        self.first_token_at: Optional[float] = None
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.monotonic()) >= self.deadline)
+
+    def complete(self) -> None:
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"{self.request_id} not finished after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return list(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def prompt_bucket(length: int, *, floor: Optional[int] = None,
+                  cap: Optional[int] = None) -> int:
+    """Pad a prompt length up to its power-of-two bucket."""
+    floor = floor if floor is not None else int(
+        os.environ.get("HVD_SERVE_BUCKET_MIN", "8"))
+    b = max(floor, 1)
+    while b < length:
+        b *= 2
+    if cap is not None:
+        b = min(b, cap)
+    return b
+
+
+def bucket_requests(requests: Sequence[Request],
+                    *, floor: Optional[int] = None,
+                    cap: Optional[int] = None) -> Dict[int, List[Request]]:
+    """Group an admitted set by padded prompt-length bucket (one prefill
+    compile/run per group)."""
+    groups: Dict[int, List[Request]] = {}
+    for r in requests:
+        groups.setdefault(
+            prompt_bucket(len(r.prompt), floor=floor, cap=cap), []).append(r)
+    return groups
+
+
+class DynamicBatcher:
+    """Bounded FIFO with size/deadline admission triggers (module doc)."""
+
+    def __init__(self, max_queue: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 on_shed: Optional[Callable[[Request, str], None]] = None):
+        self.max_queue = max_queue if max_queue is not None else int(
+            os.environ.get("HVD_SERVE_MAX_QUEUE", "256"))
+        self.max_wait_s = (max_wait_ms if max_wait_ms is not None else float(
+            os.environ.get("HVD_SERVE_MAX_WAIT_MS", "5"))) / 1e3
+        self._on_shed = on_shed
+        self._queue: List[Request] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+
+    def submit(self, request: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise QueueFullError("batcher is closed")
+            if len(self._queue) >= self.max_queue:
+                # Explicit backpressure: reject NOW.  The caller (server
+                # or scheduler) turns this into a 503 / reroute; counting
+                # happens there so shed-at-replica vs shed-at-server stay
+                # distinguishable.
+                raise QueueFullError(
+                    f"queue at capacity ({self.max_queue})")
+            self._queue.append(request)
+            self._cond.notify_all()
+
+    def requeue_front(self, requests: Sequence[Request]) -> None:
+        """Re-admit already-accepted work at the FRONT of the queue (dead
+        replica drain).  Deliberately bypasses the capacity bound: these
+        requests were admitted once — shedding them now would turn a
+        replica loss into dropped accepted work."""
+        if not requests:
+            return
+        with self._cond:
+            self._queue[0:0] = list(requests)
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _pop_expired(self, now: float, expired: List[Request]) -> None:
+        # Caller holds the lock.  Only REMOVES from the queue; failing
+        # the requests and firing on_shed happen after the lock is
+        # released (get_admission's finally) — on_shed reaches into
+        # ServeMetrics, and calling it here would order batcher-lock →
+        # metrics-lock against /metrics' metrics-lock → batcher-lock
+        # queue-depth sampling (AB/BA deadlock).
+        kept = []
+        for r in self._queue:
+            (expired if r.expired(now) else kept).append(r)
+        self._queue = kept
+
+    def get_admission(self, free_slots: int,
+                      block_s: float = 0.0) -> List[Request]:
+        """Up to ``free_slots`` requests, honoring the size/deadline
+        triggers.  ``block_s`` > 0 waits that long for the triggers when
+        the queue cannot fire them yet (the engine blocks when idle and
+        polls with 0 between decode steps)."""
+        if free_slots <= 0:
+            return []
+        deadline = time.monotonic() + block_s
+        expired: List[Request] = []
+        try:
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    self._pop_expired(now, expired)
+                    if self._queue:
+                        oldest_age = now - self._queue[0].submitted_at
+                        if (len(self._queue) >= free_slots
+                                or oldest_age >= self.max_wait_s):
+                            taken = self._queue[:free_slots]
+                            del self._queue[:free_slots]
+                            return taken
+                        # Triggers not fired: wait only until the oldest
+                        # ages out (never past the caller's budget).
+                        wait = min(self.max_wait_s - oldest_age,
+                                   max(deadline - now, 0.0))
+                    else:
+                        wait = deadline - now
+                    if self._closed or wait <= 0:
+                        return []
+                    self._cond.wait(wait)
+        finally:
+            # Lock released (the with-block exits before finally runs).
+            for r in expired:
+                r.fail(DeadlineExceededError(
+                    f"{r.request_id} expired after "
+                    f"{time.monotonic() - r.submitted_at:.3f}s in queue"))
+                if self._on_shed:
+                    self._on_shed(r, "expired")
+
+    def drain(self) -> List[Request]:
+        """Empty the queue and return the requests (dead-replica path —
+        they will be resubmitted, not failed)."""
+        with self._cond:
+            taken, self._queue = self._queue, []
+            return taken
+
+    def close(self) -> List[Request]:
+        with self._cond:
+            self._closed = True
+            taken, self._queue = self._queue, []
+            self._cond.notify_all()
+            return taken

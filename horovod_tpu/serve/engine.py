@@ -1,0 +1,556 @@
+"""Continuous-batching inference engine over the repo's ``models/``.
+
+No reference analog — the reference ends at the optimizer step.  The design
+is Orca's iteration-level scheduling (OSDI '22) on the vLLM observation
+(SOSP '23) that the KV cache is the memory object to manage:
+
+* **slot-based KV cache** — one pre-allocated cache of
+  ``[L, max_batch, max_len, H, Dh]`` per replica; a sequence owns one batch
+  *slot* for its lifetime and is retired at token granularity, so a short
+  answer never waits for a long one sharing its batch;
+* **admission between decode steps** — every loop iteration first admits
+  new requests into free slots (prefill), then advances EVERY active
+  sequence one token (decode), so the batch composition changes at
+  token-step granularity (continuous batching);
+* **bucketed compilation** — prefill jits once per (padded request count,
+  padded prompt length) power-of-two bucket and decode jits exactly once
+  (full ``max_batch``), so steady-state serving never recompiles.
+
+Exactness: decoding is greedy (argmax) and every per-sequence computation
+is row-independent inside the batch — padded cache positions are masked to
+``-1e30`` before the softmax (weight exactly 0) and inactive rows only
+ever scatter into their own cache row — so the tokens a request receives
+are bit-identical whether it ran alone or packed in a full batch.  The e2e
+test pins batched-vs-single parity on this.
+
+Model support: the ``models/`` Transformer (dense causal attention,
+``TransformerAdapter`` — stacked ``scan_layers`` checkpoints are unstacked
+once at load) and the MNIST-scale MLP as a trivially-cheap stand-in for
+engine-mechanics tests (``MLPAdapter``: next token = argmax MLP(one-hot
+(token)), no cache).  Everything runs under ``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import get_logger
+from .batcher import (DynamicBatcher, Request, bucket_requests,
+                      prompt_bucket)
+from .metrics import ServeMetrics
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    b = max(floor, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Model adapters
+# ---------------------------------------------------------------------------
+
+class ModelAdapter:
+    """Engine-facing model interface.
+
+    The engine owns slot bookkeeping; the adapter owns the math and the
+    per-bucket compile caches.  ``prefill``/``decode`` take and return the
+    cache pytree so the engine can thread it through jit with donation.
+    """
+
+    vocab_size: int
+    max_len: int
+
+    def init_cache(self, max_batch: int):
+        raise NotImplementedError
+
+    def prefill(self, cache, prompts: Sequence[Sequence[int]],
+                slots: Sequence[int]):
+        """Run the prompt phase for ``prompts`` into cache rows ``slots``;
+        returns ``(cache, next_tokens)`` where ``next_tokens[i]`` is the
+        greedy first generated token of prompt i."""
+        raise NotImplementedError
+
+    def decode(self, cache, tokens: np.ndarray, positions: np.ndarray):
+        """One token step for the whole slot batch: feed ``tokens[b]`` at
+        ``positions[b]``; returns ``(cache, next_tokens[max_batch])``.
+        Rows whose slot is inactive carry token 0 / position 0 and their
+        output is ignored."""
+        raise NotImplementedError
+
+
+class TransformerAdapter(ModelAdapter):
+    """KV-cache decoding for ``models.Transformer`` parameters.
+
+    Runs the Block math (ln1 → qkv → causal attention → proj residual →
+    ln2 → fc1/gelu/fc2 residual; f32 layernorm islands, tied LM head) as
+    pure functions over the param pytree, with an explicit per-layer KV
+    cache the flax module doesn't carry.  Serving math is forced to f32
+    (``HVD_SERVE_DTYPE`` may widen training bf16 checkpoints) — greedy
+    parity across batch compositions is the contract and f32 keeps the
+    argmax far from dtype noise.
+
+    Constraints (asserted): dense local attention only — a serving replica
+    is data-parallel and holds the full model, so ``seq_parallel``/MoE
+    configs are for the training mesh, not here.
+    """
+
+    def __init__(self, cfg, params, max_len: Optional[int] = None):
+        import jax.numpy as jnp
+        if cfg.seq_parallel is not None or cfg.moe_experts:
+            raise ValueError(
+                "serving replicas are data-parallel: load the checkpoint "
+                "with seq_parallel=None / moe_experts=0 (the params are "
+                "layout-compatible)")
+        self.cfg = cfg
+        self.vocab_size = cfg.vocab_size
+        self.max_len = min(max_len or cfg.max_len, cfg.max_len)
+        self.num_layers = cfg.num_layers
+        self.head_dim = cfg.d_model // cfg.num_heads
+        dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}[
+            os.environ.get("HVD_SERVE_DTYPE", "f32")]
+        params = _unstack_if_scanned(params, cfg.num_layers)
+        import jax
+        self.params = jax.tree.map(
+            lambda a: jnp.asarray(a, dtype=dtype), params)
+        self._dtype = dtype
+        self._prefill_cache: Dict[Tuple[int, int], object] = {}
+        self._decode_fn = None
+        self._max_batch = None
+
+    # -- cache --------------------------------------------------------------
+
+    def init_cache(self, max_batch: int):
+        import jax.numpy as jnp
+        self._max_batch = max_batch
+        shape = (self.num_layers, max_batch, self.max_len,
+                 self.cfg.num_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, self._dtype),
+                "v": jnp.zeros(shape, self._dtype)}
+
+    # -- functional forward pieces ------------------------------------------
+
+    def _ln(self, x, p, eps):
+        import jax.numpy as jnp
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        y = (x32 - mu) * (1.0 / jnp.sqrt(var + eps))
+        return (y * p["scale"] + p["bias"]).astype(jnp.float32)
+
+    def _ffn(self, x, blk):
+        import jax
+        import jax.numpy as jnp
+        h = self._ln(x, blk["ln2"], 1e-5).astype(self._dtype)
+        h = jnp.einsum("...d,df->...f", h, blk["fc1"]["kernel"]) \
+            + blk["fc1"]["bias"]
+        h = jax.nn.gelu(h)  # flax nn.gelu default: approximate
+        h = jnp.einsum("...f,fd->...d", h, blk["fc2"]["kernel"]) \
+            + blk["fc2"]["bias"]
+        return x + h
+
+    def _qkv(self, x, blk):
+        import jax.numpy as jnp
+        h = self._ln(x, blk["ln1"], 1e-5).astype(self._dtype)
+        qkv = jnp.einsum("...d,dthe->...the", h,
+                         blk["attn"]["qkv"]["kernel"]) \
+            + blk["attn"]["qkv"]["bias"]
+        return qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+
+    def _proj(self, x, out, blk):
+        import jax.numpy as jnp
+        return x + (jnp.einsum("...he,hed->...d", out,
+                               blk["attn"]["proj"]["kernel"])
+                    + blk["attn"]["proj"]["bias"])
+
+    def _logits(self, x, params):
+        import jax.numpy as jnp
+        x = self._ln(x, params["ln_f"], 1e-6)  # nn.LayerNorm default eps
+        return jnp.einsum("...d,vd->...v", x.astype(self._dtype),
+                          params["wte"]["embedding"]).astype(jnp.float32)
+
+    # -- prefill ------------------------------------------------------------
+
+    def _build_prefill(self, n: int, p_len: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        scale = 1.0 / math.sqrt(self.head_dim)
+        L = self.num_layers
+
+        def fn(params, cache, tokens, lengths, slots):
+            # tokens [n, P] int32; lengths [n]; slots [n] (slot >= max_batch
+            # marks a padding row: scatter drops out-of-bounds rows, see
+            # OOB note below).
+            x = params["wte"]["embedding"][tokens] \
+                + params["wpe"]["embedding"][jnp.arange(p_len)][None]
+            ck, cv = cache["k"], cache["v"]
+            iq = lax.broadcasted_iota(jnp.int32, (p_len, p_len), 0)
+            ik = lax.broadcasted_iota(jnp.int32, (p_len, p_len), 1)
+            causal = (iq >= ik)[None, None]
+            for l in range(L):
+                blk = params[f"block_{l}"]
+                q, k, v = self._qkv(x, blk)
+                # Out-of-bounds slot indices (padding rows) are DROPPED by
+                # jax scatter's default FILL_OR_DROP mode — a padding row
+                # must not write anyone's cache.
+                ck = ck.at[l, slots, :p_len].set(k)
+                cv = cv.at[l, slots, :p_len].set(v)
+                s = jnp.einsum("nqhe,nkhe->nhqk",
+                               q.astype(jnp.float32),
+                               k.astype(jnp.float32)) * scale
+                s = jnp.where(causal, s, jnp.float32(-1e30))
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("nhqk,nkhe->nqhe", p,
+                                 v.astype(jnp.float32)).astype(self._dtype)
+                x = self._ffn(self._proj(x, out, blk), blk)
+            # LM head only at each prompt's last real position (padding
+            # tail positions produce garbage that is never read).
+            last = jnp.take_along_axis(
+                x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            logits = self._logits(last, params)
+            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def prefill(self, cache, prompts, slots):
+        import jax.numpy as jnp
+        n_bucket = _next_pow2(len(prompts))
+        max_p = max(len(p) for p in prompts)
+        # Same bucketing policy as the batcher's admission grouping
+        # (batcher.prompt_bucket) — the compile-cache key must agree with
+        # how bucket_requests grouped the batch.
+        p_bucket = prompt_bucket(max_p, cap=self.max_len)
+        if max_p > self.max_len:
+            raise ValueError(f"prompt length {max_p} exceeds max_len "
+                             f"{self.max_len}")
+        key = (n_bucket, p_bucket)
+        if key not in self._prefill_cache:
+            self._prefill_cache[key] = self._build_prefill(*key)
+        tokens = np.zeros((n_bucket, p_bucket), np.int32)
+        lengths = np.ones((n_bucket,), np.int32)
+        # Padding rows get slot index max_batch: out of range on purpose
+        # (their cache scatter is dropped, their logits discarded).
+        slot_arr = np.full((n_bucket,), self._max_batch, np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+            lengths[i] = len(p)
+            slot_arr[i] = slots[i]
+        cache, nxt = self._prefill_cache[key](
+            self.params, cache, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(slot_arr))
+        return cache, np.asarray(nxt)[:len(prompts)]
+
+    # -- decode -------------------------------------------------------------
+
+    def _build_decode(self):
+        import jax
+        import jax.numpy as jnp
+        scale = 1.0 / math.sqrt(self.head_dim)
+        L, B = self.num_layers, self._max_batch
+        S = self.max_len
+
+        def fn(params, cache, tokens, positions):
+            # tokens [B] int32 (last token per slot), positions [B] (the
+            # cache index this token's K/V lands at = current length).
+            pos = jnp.minimum(positions, S - 1)
+            x = params["wte"]["embedding"][tokens] \
+                + params["wpe"]["embedding"][pos]  # [B, d]
+            ck, cv = cache["k"], cache["v"]
+            rows = jnp.arange(B)
+            s_idx = jnp.arange(S)[None, None, :]          # [1, 1, S]
+            valid = s_idx <= pos[:, None, None]           # [B, 1, S]
+            for l in range(L):
+                blk = params[f"block_{l}"]
+                q, k, v = self._qkv(x, blk)               # [B, H, Dh]
+                ck = ck.at[l, rows, pos].set(k)
+                cv = cv.at[l, rows, pos].set(v)
+                s = jnp.einsum("bhe,bshe->bhs",
+                               q.astype(jnp.float32),
+                               ck[l].astype(jnp.float32)) * scale
+                # Cache positions beyond this sequence's length hold other
+                # incarnations' garbage — mask to -1e30 so their softmax
+                # weight is exactly 0 and batched == single bit-for-bit.
+                s = jnp.where(valid, s, jnp.float32(-1e30))
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhs,bshe->bhe", p,
+                                 cv[l].astype(jnp.float32)
+                                 ).astype(self._dtype)
+                x = self._ffn(self._proj(x, out, blk), blk)
+            logits = self._logits(x, params)
+            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def decode(self, cache, tokens, positions):
+        import jax.numpy as jnp
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        cache, nxt = self._decode_fn(
+            self.params, cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32))
+        return cache, np.asarray(nxt)
+
+
+def _unstack_if_scanned(params, num_layers: int):
+    """Accept either param layout: ``scan_layers`` checkpoints (stacked
+    ``blocks/block``) are converted to the unrolled ``block_i`` layout the
+    adapter's per-layer loop indexes (models.unstack_block_params)."""
+    inner = params.get("params", params)
+    if "blocks" in inner:
+        from ..models.transformer import unstack_block_params
+        inner = unstack_block_params(inner)
+    return inner
+
+
+class MLPAdapter(ModelAdapter):
+    """Cache-free stand-in model for engine-mechanics tests: the next
+    token is ``argmax(MLP(one_hot(token)))`` — a deterministic Markov
+    chain over the vocab, so batching/requeue/parity logic is exercised
+    without transformer compile cost."""
+
+    def __init__(self, mlp, params, vocab_size: int, max_len: int = 1024):
+        import jax
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self._apply = jax.jit(
+            lambda tokens: jax.numpy.argmax(
+                mlp.apply({"params": params},
+                          jax.nn.one_hot(tokens, vocab_size)), axis=-1))
+
+    def init_cache(self, max_batch: int):
+        return ()
+
+    def prefill(self, cache, prompts, slots):
+        last = np.asarray([p[-1] for p in prompts], np.int32)
+        return cache, np.asarray(self._apply(last))
+
+    def decode(self, cache, tokens, positions):
+        return cache, np.asarray(self._apply(np.asarray(tokens, np.int32)))
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class _Slot:
+    __slots__ = ("request", "length")
+
+    def __init__(self, request: Request, length: int):
+        self.request = request
+        self.length = length  # prompt + generated so far (cache positions)
+
+
+class InferenceEngine:
+    """One continuous-batching decode loop (one per serving replica).
+
+    Owns: the model adapter, the slot table, the KV cache, and a worker
+    thread running admit → prefill → decode forever.  Completion is
+    per-request (batcher.Request events); the loop never blocks while any
+    sequence is active.
+    """
+
+    def __init__(self, adapter: ModelAdapter,
+                 batcher: Optional[DynamicBatcher] = None,
+                 metrics: Optional[ServeMetrics] = None,
+                 max_batch: Optional[int] = None,
+                 replica_id: str = "replica-0"):
+        self.adapter = adapter
+        self.max_batch = max_batch if max_batch is not None else int(
+            os.environ.get("HVD_SERVE_MAX_BATCH", "8"))
+        self.batcher = batcher or DynamicBatcher()
+        self.metrics = metrics or ServeMetrics()
+        if self.batcher._on_shed is None:
+            # Deadline sheds happen inside the batcher (at admission);
+            # surface them in this engine's metrics ("expired" outcome).
+            self.batcher._on_shed = \
+                lambda req, why: self.metrics.count_request(why)
+        self.replica_id = replica_id
+        self._cache = adapter.init_cache(self.max_batch)
+        self._slots: List[Optional[_Slot]] = [None] * self.max_batch
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def load(self) -> int:
+        """Routing load: in-flight sequences + queued requests."""
+        return self.active_count + self.batcher.depth()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"hvd-serve-engine-{self.replica_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def drain(self) -> List[Request]:
+        """Stop the loop and return all in-flight requests WITHOUT
+        completing them (dead-replica path: the scheduler resubmits them
+        elsewhere; generated-so-far tokens are discarded — greedy decoding
+        reproduces them exactly on the new replica)."""
+        self.stop()
+        with self._lock:
+            inflight = []
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    s.request.generated = []
+                    s.request.requeues += 1
+                    inflight.append(s.request)
+                    self._slots[i] = None
+            return inflight
+
+    # -- the loop ------------------------------------------------------------
+
+    def _free_slots(self) -> List[int]:
+        with self._lock:
+            return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit(self, block_s: float) -> int:
+        free = self._free_slots()
+        if not free:
+            return 0
+        admitted = self.batcher.get_admission(len(free), block_s=block_s)
+        if not admitted:
+            return 0
+        cursor = 0
+        for p_bucket, group in sorted(
+                bucket_requests(admitted, cap=self.adapter.max_len).items()):
+            # One prefill per shape bucket (batcher module doc); requests
+            # whose prompt would overflow the cache fail loudly here.
+            runnable, doomed = [], []
+            for r in group:
+                (runnable if len(r.prompt) + r.max_new_tokens
+                 <= self.adapter.max_len else doomed).append(r)
+            for r in doomed:
+                r.fail(ValueError(
+                    f"{r.request_id}: prompt+max_new_tokens "
+                    f"{len(r.prompt) + r.max_new_tokens} exceeds max_len "
+                    f"{self.adapter.max_len}"))
+                self.metrics.count_request("error")
+            if not runnable:
+                continue
+            slots = free[cursor:cursor + len(runnable)]
+            cursor += len(runnable)
+            t0 = time.monotonic()
+            self._cache, first = self.adapter.prefill(
+                self._cache, [r.prompt for r in runnable], slots)
+            now = time.monotonic()
+            with self._lock:
+                for r, slot, tok in zip(runnable, slots, first):
+                    r.replica_id = self.replica_id
+                    r.first_token_at = now
+                    r.generated.append(int(tok))
+                    self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
+                    if self._finished(r, int(tok)):
+                        self._complete(r)
+                    else:
+                        # Cache holds positions 0..P-1; the first decode
+                        # feeds the prefill's token at position P.
+                        self._slots[slot] = _Slot(r, len(r.prompt))
+            get_logger().debug(
+                "%s: admitted %d (bucket %d) in %.1f ms", self.replica_id,
+                len(runnable), p_bucket, (now - t0) * 1e3)
+        return cursor
+
+    @staticmethod
+    def _finished(r: Request, token: int) -> bool:
+        return (len(r.generated) >= r.max_new_tokens
+                or (r.eos_id is not None and token == r.eos_id))
+
+    def _complete(self, r: Request) -> None:
+        r.complete()
+        self.metrics.count_request("ok")
+
+    def _decode_once(self) -> None:
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+        if not active:
+            return
+        tokens = np.zeros((self.max_batch,), np.int32)
+        positions = np.zeros((self.max_batch,), np.int32)
+        for i, s in active:
+            tokens[i] = s.request.generated[-1]
+            positions[i] = s.length  # next cache index = current length
+        t0 = time.monotonic()
+        self._cache, nxt = self.adapter.decode(self._cache, tokens,
+                                               positions)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            for i, s in active:
+                if self._slots[i] is not s:
+                    continue  # drained concurrently
+                tok = int(nxt[i])
+                s.request.generated.append(tok)
+                s.length += 1
+                if self._finished(s.request, tok) \
+                        or s.length >= self.adapter.max_len:
+                    self._complete(s.request)
+                    self._slots[i] = None
+        self.steps += 1
+        self.metrics.observe_decode_step(dt_ms, len(active), len(active))
+        self.metrics.maybe_emit_timeline()
+
+    def _run(self) -> None:
+        idle_block_s = float(os.environ.get("HVD_SERVE_IDLE_POLL_S", "0.05"))
+        while not self._stop.is_set():
+            try:
+                busy = self.active_count > 0
+                # Iteration-level scheduling: admission happens BETWEEN
+                # decode steps — non-blocking while sequences are active,
+                # blocking (bounded) when idle.
+                self._admit(0.0 if busy else idle_block_s)
+                self._decode_once()
+            except Exception as e:
+                # A dying loop thread would hang every in-flight request
+                # until its client timeout: fail them NOW with the real
+                # error, reset the cache (its contents are suspect), and
+                # keep serving — one poisoned batch must not take the
+                # replica down.
+                get_logger().exception(
+                    "%s: engine step failed: %s", self.replica_id, e)
+                with self._lock:
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            s.request.fail(e)
+                            self.metrics.count_request("error")
+                            self._slots[i] = None
+                self._cache = self.adapter.init_cache(self.max_batch)
+
+    # -- synchronous one-shot (bench / tests) --------------------------------
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 timeout_s: float = 300.0) -> List[int]:
+        """Submit one request through the running loop and wait for it."""
+        if self._thread is None:
+            self.start()
+        r = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        self.batcher.submit(r)
+        return r.result(timeout=timeout_s)

@@ -1,0 +1,249 @@
+"""Serving metrics: latency histograms, occupancy, throughput counters.
+
+No reference analog — the reference (and the training half of this repo)
+ends at the optimizer step.  The metric set follows the continuous-batching
+serving literature: Orca (OSDI '22) makes *iteration-level batch occupancy*
+the defining throughput statistic (a serving engine whose occupancy sits at
+1 has degenerated into request-level batching), and TTFT / per-output-token
+latency are the standard user-facing latency split (prefill cost vs decode
+cadence).
+
+Export surfaces:
+
+* ``render()`` — Prometheus text exposition for the HTTP ``/metrics``
+  endpoint (serve/server.py);
+* ``snapshot()`` — plain dict for the ``BENCH_MODEL=serve`` record
+  (bench.py) and tests;
+* ``maybe_emit_timeline()`` — Chrome-trace counter events through
+  ``timeline.Timeline.serve_counter`` (SERVE/<component> counters chart
+  next to the training-side op lifecycle in the same viewer), rate-limited
+  to every ``HVD_SERVE_TIMELINE_EVERY`` decode steps so the trace stays
+  bounded under sustained load.
+
+Everything is guarded by one lock: observers run on engine threads while
+``/metrics`` renders on HTTP handler threads.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List
+
+#: Histogram bucket upper bounds in milliseconds (Prometheus ``le`` label).
+#: Spans sub-ms MLP decodes through multi-second cold-compile prefills.
+DEFAULT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (Prometheus semantics: cumulative
+    bucket counts, +Inf implicit via ``count``)."""
+
+    def __init__(self, buckets_ms=DEFAULT_BUCKETS_MS):
+        self.bounds: List[float] = list(buckets_ms)
+        self.counts: List[int] = [0] * len(self.bounds)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value_ms: float) -> None:
+        self.count += 1
+        self.sum += value_ms
+        for i, b in enumerate(self.bounds):
+            if value_ms <= b:
+                self.counts[i] += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound of the
+        bucket containing the q-th observation) — good enough for bench
+        records; exact quantiles would need reservoir state."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        for i, b in enumerate(self.bounds):
+            if self.counts[i] >= target:
+                return b
+        return self.bounds[-1]
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum_ms": round(self.sum, 3),
+                "p50_ms": self.quantile(0.5), "p99_ms": self.quantile(0.99)}
+
+
+class ServeMetrics:
+    """One instance per server (shared across that server's replicas —
+    replica identity travels in the per-counter labels where it matters)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.ttft_ms = Histogram()
+        self.token_step_ms = Histogram()
+        self.tokens_total = 0
+        self.decode_steps_total = 0
+        self.prefills_total = 0
+        # Request outcomes: ok / shed (queue full) / expired (deadline) /
+        # requeued (drained off a dead replica, re-routed) / error.
+        self.requests: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
+                                         "requeued": 0, "error": 0}
+        # Batch occupancy: sequences active per decode step.
+        self.occupancy_last = 0
+        self.occupancy_max = 0
+        self.occupancy_sum = 0
+        self.occupancy_samples = 0
+        self._queue_depth_fns: Dict[str, object] = {}
+        self._timeline = None
+        self._timeline_every = int(os.environ.get(
+            "HVD_SERVE_TIMELINE_EVERY", "16"))
+        self._steps_since_emit = 0
+
+    # -- observers (engine/batcher threads) ---------------------------------
+
+    def observe_ttft(self, ms: float) -> None:
+        with self._lock:
+            self.ttft_ms.observe(ms)
+            self.prefills_total += 1
+            self.tokens_total += 1  # the prefill's first generated token
+
+    def observe_decode_step(self, ms: float, occupancy: int,
+                            new_tokens: int) -> None:
+        with self._lock:
+            self.token_step_ms.observe(ms)
+            self.decode_steps_total += 1
+            self.tokens_total += new_tokens
+            self.occupancy_last = occupancy
+            self.occupancy_max = max(self.occupancy_max, occupancy)
+            self.occupancy_sum += occupancy
+            self.occupancy_samples += 1
+            self._steps_since_emit += 1
+
+    def count_request(self, outcome: str) -> None:
+        with self._lock:
+            self.requests[outcome] = self.requests.get(outcome, 0) + 1
+
+    def register_queue_depth(self, replica_id: str, fn) -> None:
+        """``fn`` is sampled at render time — queue depth is a gauge, not
+        a counter, so it is read where it lives instead of mirrored."""
+        with self._lock:
+            self._queue_depth_fns[replica_id] = fn
+
+    # -- export -------------------------------------------------------------
+
+    def _queue_depths(self) -> Dict[str, int]:
+        # NEVER called under self._lock: the depth fns take the batchers'
+        # locks, and an engine thread shedding under a batcher lock may
+        # need self._lock (count_request) — sampling under self._lock
+        # would be the other half of an AB/BA deadlock.
+        with self._lock:
+            fns = dict(self._queue_depth_fns)
+        out = {}
+        for rid, fn in fns.items():
+            try:
+                out[rid] = int(fn())
+            except Exception:
+                out[rid] = -1
+        return out
+
+    def snapshot(self) -> dict:
+        depths = self._queue_depths()
+        with self._lock:
+            elapsed = max(time.monotonic() - self.started_at, 1e-9)
+            occ_mean = (self.occupancy_sum / self.occupancy_samples
+                        if self.occupancy_samples else 0.0)
+            return {
+                "tokens_total": self.tokens_total,
+                "tokens_per_sec": round(self.tokens_total / elapsed, 2),
+                "decode_steps": self.decode_steps_total,
+                "prefills": self.prefills_total,
+                "requests": dict(self.requests),
+                "occupancy": {"last": self.occupancy_last,
+                              "max": self.occupancy_max,
+                              "mean": round(occ_mean, 3)},
+                "queue_depth": depths,
+                "ttft": self.ttft_ms.to_dict(),
+                "token_step": self.token_step_ms.to_dict(),
+            }
+
+    def render(self) -> str:
+        """Prometheus text exposition (version 0.0.4 format)."""
+        depths = self._queue_depths()
+        with self._lock:
+            lines = []
+
+            def hist(name, h: Histogram, help_):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for bound, c in zip(h.bounds, h.counts):
+                    cum = c
+                    lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
+                lines.append(f"{name}_sum {h.sum:g}")
+                lines.append(f"{name}_count {h.count}")
+
+            hist("hvd_serve_ttft_ms", self.ttft_ms,
+                 "Time to first token (prefill wait + compute), ms")
+            hist("hvd_serve_token_step_ms", self.token_step_ms,
+                 "Decode step duration (per-output-token latency), ms")
+            lines.append("# TYPE hvd_serve_tokens_total counter")
+            lines.append(f"hvd_serve_tokens_total {self.tokens_total}")
+            lines.append("# TYPE hvd_serve_decode_steps_total counter")
+            lines.append(
+                f"hvd_serve_decode_steps_total {self.decode_steps_total}")
+            lines.append("# TYPE hvd_serve_requests_total counter")
+            for outcome, n in sorted(self.requests.items()):
+                lines.append(
+                    f'hvd_serve_requests_total{{outcome="{outcome}"}} {n}')
+            lines.append("# TYPE hvd_serve_batch_occupancy gauge")
+            lines.append(f"hvd_serve_batch_occupancy {self.occupancy_last}")
+            lines.append("# TYPE hvd_serve_batch_occupancy_max gauge")
+            lines.append(
+                f"hvd_serve_batch_occupancy_max {self.occupancy_max}")
+            occ_mean = (self.occupancy_sum / self.occupancy_samples
+                        if self.occupancy_samples else 0.0)
+            lines.append("# TYPE hvd_serve_batch_occupancy_mean gauge")
+            lines.append(f"hvd_serve_batch_occupancy_mean {occ_mean:g}")
+            lines.append("# TYPE hvd_serve_queue_depth gauge")
+            for rid, depth in sorted(depths.items()):
+                lines.append(
+                    f'hvd_serve_queue_depth{{replica="{rid}"}} {depth}')
+            elapsed = max(time.monotonic() - self.started_at, 1e-9)
+            lines.append("# TYPE hvd_serve_tokens_per_sec gauge")
+            lines.append(
+                f"hvd_serve_tokens_per_sec {self.tokens_total / elapsed:g}")
+            return "\n".join(lines) + "\n"
+
+    # -- timeline bridge ----------------------------------------------------
+
+    def set_timeline(self, timeline) -> None:
+        """Register a ``timeline.Timeline``; subsequent decode steps emit
+        SERVE/* counter events (rate-limited, see module docstring)."""
+        with self._lock:
+            self._timeline = timeline
+            self._steps_since_emit = 0
+
+    def maybe_emit_timeline(self, force: bool = False) -> None:
+        with self._lock:
+            tl = self._timeline
+            if tl is None:
+                return
+            if not force and self._steps_since_emit < self._timeline_every:
+                return
+            self._steps_since_emit = 0
+        depth = sum(max(d, 0) for d in self._queue_depths().values())
+        with self._lock:
+            occ_mean = (self.occupancy_sum / self.occupancy_samples
+                        if self.occupancy_samples else 0.0)
+            counters = {
+                "tokens_total": self.tokens_total,
+                "occupancy": self.occupancy_last,
+                "occupancy_mean": round(occ_mean, 3),
+                "queue_depth": depth,
+                "ttft_p50_ms": self.ttft_ms.quantile(0.5),
+                "token_step_p50_ms": self.token_step_ms.quantile(0.5),
+            }
+        try:
+            tl.serve_counter("engine", counters)
+        except Exception:
+            pass  # the metrics path must never take down the decode loop

@@ -1,0 +1,267 @@
+"""Replica scheduler: serving replicas over ``process_sets``, least-loaded
+routing, preemption-aware failover.
+
+Mapping: a serving *replica* is an independent copy of the model owning a
+disjoint subgroup of the job's slot ranks — exactly what
+``process_sets.ProcessSet`` models for training collectives
+(``build_replicas`` registers one contiguous set per replica via
+``partition_process_sets``).  Requests route to the least-loaded healthy
+replica (load = in-flight sequences + queued requests — queue depth alone
+under-counts a replica mid-decode).
+
+Failure handling rides the elastic subsystem's machinery: TPU-VM
+preemption notices surface as host markers in the rendezvous KV scope
+``preempt`` (elastic/preemption.PreemptionSentinel), and ``horovodrun``'s
+elastic driver reports lost ranks the same way the training side consumes
+them.  ``watch_preemption`` polls that scope; any replica whose process
+set intersects a lost host's ranks is marked dead: it leaves the routing
+set, its queued AND in-flight requests are resubmitted to the survivors
+(the drained replica's only — nobody else's work moves), and ``healthz``
+degrades.  Requeued requests restart from the prompt — greedy decoding
+makes the eventual answer identical, so a client never observes the loss
+beyond latency.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils import get_logger
+from .batcher import DynamicBatcher, QueueFullError, Request
+from .engine import InferenceEngine, ModelAdapter
+from .metrics import ServeMetrics
+
+
+class NoHealthyReplicaError(Exception):
+    """Every replica is dead — the server answers 503 from /generate and
+    ``/healthz`` reports ``unserving``."""
+
+
+class Replica:
+    """One serving replica: a process set, an engine, and its batcher."""
+
+    def __init__(self, replica_id: str, process_set, engine: InferenceEngine):
+        self.replica_id = replica_id
+        self.process_set = process_set
+        self.engine = engine
+        self.state = "healthy"  # healthy | dead
+
+    @property
+    def ranks(self) -> List[int]:
+        if self.process_set is None:
+            return []
+        if self.process_set.ranks is None:
+            return list(range(self.process_set.size() or 0))
+        return list(self.process_set.ranks)
+
+    def load(self) -> int:
+        return self.engine.load()
+
+    def to_dict(self) -> dict:
+        return {"id": self.replica_id, "state": self.state,
+                "ranks": self.ranks, "load": self.load(),
+                "active": self.engine.active_count,
+                "queued": self.engine.batcher.depth()}
+
+
+class ReplicaScheduler:
+    """Routes requests across replicas; drains dead ones (module doc)."""
+
+    def __init__(self, replicas: Sequence[Replica],
+                 metrics: Optional[ServeMetrics] = None):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas: List[Replica] = list(replicas)
+        self.metrics = metrics or ServeMetrics()
+        self._lock = threading.Lock()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        for r in self.replicas:
+            self.metrics.register_queue_depth(
+                r.replica_id, r.engine.batcher.depth)
+
+    # -- routing -------------------------------------------------------------
+
+    def _healthy(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.state == "healthy"]
+
+    def submit(self, request: Request) -> Replica:
+        """Least-loaded routing with failover: a replica at queue capacity
+        backpressures; the next-least-loaded healthy replica is tried
+        before the request is shed."""
+        candidates = sorted(self._healthy(), key=lambda r: r.load())
+        if not candidates:
+            self.metrics.count_request("error")
+            raise NoHealthyReplicaError("no healthy replicas")
+        last_exc: Optional[Exception] = None
+        for replica in candidates:
+            try:
+                replica.engine.batcher.submit(request)
+                return replica
+            except QueueFullError as e:
+                last_exc = e
+        self.metrics.count_request("shed")
+        raise last_exc  # every healthy queue is full: explicit shed
+
+    def start(self) -> "ReplicaScheduler":
+        for r in self.replicas:
+            r.engine.start()
+        return self
+
+    def stop(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10)
+            self._watch_thread = None
+        for r in self.replicas:
+            for req in r.engine.batcher.close():
+                req.fail(NoHealthyReplicaError("server shutting down"))
+            # drain() (not stop()) so in-flight requests fail NOW instead
+            # of parking their handler threads for the full request
+            # timeout.
+            for req in r.engine.drain():
+                req.fail(NoHealthyReplicaError("server shutting down"))
+
+    # -- failure handling ----------------------------------------------------
+
+    def report_rank_lost(self, rank: int) -> Optional[str]:
+        """Elastic/preemption integration point: a lost slot rank kills
+        the replica whose process set contains it.  Returns the dead
+        replica's id (None if the rank maps to no live replica)."""
+        with self._lock:
+            victim = next((r for r in self.replicas
+                           if r.state == "healthy" and rank in r.ranks),
+                          None)
+        if victim is None:
+            return None
+        self.mark_dead(victim.replica_id,
+                       reason=f"rank {rank} lost")
+        return victim.replica_id
+
+    def mark_dead(self, replica_id: str, reason: str = "") -> None:
+        """Remove a replica from routing and requeue ITS work (queued +
+        in-flight) onto the survivors.  Only the dead replica's requests
+        move — the survivors' batches are untouched."""
+        with self._lock:
+            victim = next((r for r in self.replicas
+                           if r.replica_id == replica_id), None)
+            if victim is None or victim.state == "dead":
+                return
+            victim.state = "dead"
+        get_logger().warning("serve: replica %s marked dead (%s); draining",
+                             replica_id, reason or "operator request")
+        # CLOSE (not merely drain) the victim's batcher: a submit() that
+        # snapshotted the victim as healthy before state flipped would
+        # otherwise enqueue into a queue nothing will ever poll; closed,
+        # that late submit raises QueueFullError and fails over to the
+        # next candidate.  close() returns the queued requests.
+        queued = victim.engine.batcher.close()
+        for req in queued:
+            req.requeues += 1  # engine.drain() bumps its own
+        orphans = queued + victim.engine.drain()
+        if not orphans:
+            return
+        # Already-accepted work must NOT shed on a replica loss: it goes
+        # to the FRONT of the survivors' queues past the capacity bound
+        # (requeue_front's contract), dealt round-robin starting at the
+        # least-loaded survivor; one batched call per survivor keeps each
+        # chunk's relative order.
+        survivors = sorted(self._healthy(), key=lambda r: r.load())
+        if not survivors:
+            for req in orphans:
+                self.metrics.count_request("error")
+                req.fail(NoHealthyReplicaError(
+                    f"replica {replica_id} lost with no survivors"))
+            return
+        chunks = {s.replica_id: [] for s in survivors}
+        for i, req in enumerate(orphans):
+            self.metrics.count_request("requeued")
+            chunks[survivors[i % len(survivors)].replica_id].append(req)
+        for s in survivors:
+            s.engine.batcher.requeue_front(chunks[s.replica_id])
+        get_logger().warning("serve: requeued %d request(s) from %s",
+                             len(orphans), replica_id)
+
+    def watch_preemption(self, kv_client, host_ranks: Dict[str, List[int]],
+                         poll_s: Optional[float] = None) -> None:
+        """Poll the rendezvous KV ``preempt`` scope (the same markers the
+        elastic driver's PreemptionAwareDiscovery consumes) and translate
+        marked hosts into dead replicas.  ``host_ranks`` maps the
+        discovery-plane hostname to the slot ranks it carries (the
+        launcher's host allocation plan; tests pass a synthetic map)."""
+        from ..elastic.preemption import PREEMPT_SCOPE
+        poll_s = poll_s if poll_s is not None else float(
+            os.environ.get("HVD_SERVE_PREEMPT_POLL_S", "1"))
+
+        def loop():
+            seen = set()
+            while not self._watch_stop.is_set():
+                try:
+                    marked = kv_client.scan(PREEMPT_SCOPE)
+                except Exception as e:
+                    get_logger().debug("preempt scan failed: %s", e)
+                    marked = {}
+                for host in marked:
+                    if host in seen:
+                        continue
+                    seen.add(host)
+                    for rank in host_ranks.get(host, []):
+                        self.report_rank_lost(rank)
+                self._watch_stop.wait(poll_s)
+
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="hvd-serve-preempt-watch")
+        self._watch_thread.start()
+
+    # -- health --------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        with self._lock:
+            replicas = [r.to_dict() for r in self.replicas]
+        healthy = sum(1 for r in replicas if r["state"] == "healthy")
+        if healthy == len(replicas):
+            status = "ok"
+        elif healthy > 0:
+            status = "degraded"
+        else:
+            status = "unserving"
+        return {"status": status, "healthy": healthy,
+                "total": len(replicas), "replicas": replicas}
+
+
+def build_replicas(adapter_factory: Callable[[], ModelAdapter],
+                   num_replicas: Optional[int] = None,
+                   max_batch: Optional[int] = None,
+                   metrics: Optional[ServeMetrics] = None
+                   ) -> ReplicaScheduler:
+    """Partition the initialized world into ``num_replicas`` process sets
+    and stand up one engine per set (adapter_factory is called per replica
+    — each replica owns its model arrays and KV cache).
+
+    Requires ``hvd.init()``; with no runtime (pure local serving) pass
+    ``num_replicas`` explicitly and the process-set mapping is skipped.
+    """
+    from .. import core as _core
+    sets: List[Optional[object]] = []
+    if _core.is_initialized():
+        from ..process_sets import partition_process_sets
+        n = num_replicas if num_replicas is not None else int(
+            os.environ.get("HVD_SERVE_REPLICAS",
+                           str(max(_core.num_slots() // 2, 1))))
+        sets = list(partition_process_sets(n))
+    else:
+        n = num_replicas or int(os.environ.get("HVD_SERVE_REPLICAS", "1"))
+        sets = [None] * n
+    metrics = metrics or ServeMetrics()
+    replicas = []
+    for i, ps in enumerate(sets):
+        rid = f"replica-{i}"
+        engine = InferenceEngine(adapter_factory(),
+                                 batcher=DynamicBatcher(),
+                                 metrics=metrics, max_batch=max_batch,
+                                 replica_id=rid)
+        replicas.append(Replica(rid, ps, engine))
+    return ReplicaScheduler(replicas, metrics=metrics)

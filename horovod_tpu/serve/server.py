@@ -1,0 +1,249 @@
+"""HTTP serving front-end: ``/generate``, ``/healthz``, ``/metrics``.
+
+Reuses the transport discipline of ``runner/http_server.py`` (the repo's
+other HTTP plane): ``ThreadingHTTPServer`` + HTTP/1.1 keep-alive with an
+explicit Content-Length on every response, ``disable_nagle_algorithm``
+(the two-write response pattern sits behind delayed ACKs otherwise — the
+same 44 ms-per-response cliff the KV server hit), and daemon handler
+threads so a slow client never pins interpreter exit.  A ``/generate``
+handler thread parks in ``Request.result()`` while engine threads decode
+— the HTTP plane adds no polling.
+
+Status mapping (explicit backpressure contract):
+
+* 200 — tokens generated;
+* 400 — malformed body;
+* 503 + ``Retry-After`` — shed: every healthy replica's queue is at
+  capacity, or no healthy replica exists (``/healthz`` says which);
+* 504 — the request's own deadline expired (queued or decoding).
+
+``hvdserve`` (pyproject console script) stands up a replica world over
+the initialized runtime — see ``run_commandline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import get_logger
+from .batcher import DeadlineExceededError, QueueFullError, Request
+from .metrics import ServeMetrics
+from .replica import NoHealthyReplicaError, ReplicaScheduler
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True  # see module doc / runner KV server
+
+    def log_message(self, fmt, *args):
+        get_logger().debug("serve: " + fmt % args)
+
+    def _reply(self, code: int, body: bytes,
+               content_type: str = "application/json",
+               extra_headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj, extra_headers=()) -> None:
+        self._reply(code, json.dumps(obj).encode(),
+                    extra_headers=extra_headers)
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            health = self.server.scheduler.healthz()
+            code = 200 if health["status"] != "unserving" else 503
+            self._reply_json(code, health)
+        elif path == "/metrics":
+            self._reply(200, self.server.metrics.render().encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply_json(404, {"error": f"unknown path {path}"})
+
+    def do_POST(self):
+        if self.path.split("?", 1)[0] != "/generate":
+            self._reply_json(404, {"error": "POST /generate only"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            prompt = payload["tokens"]
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError("'tokens' must be a non-empty list")
+            request = Request(
+                prompt,
+                max_new_tokens=int(payload.get("max_new_tokens", 16)),
+                eos_id=payload.get("eos_id"),
+                timeout_s=payload.get("timeout_s"),
+                request_id=payload.get("request_id"))
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        try:
+            self.server.scheduler.submit(request)
+            tokens = request.result(timeout=self.server.request_timeout_s)
+        except (QueueFullError, NoHealthyReplicaError) as e:
+            self._reply_json(503, {"error": str(e)},
+                             extra_headers=(("Retry-After", "1"),))
+            return
+        except (DeadlineExceededError, TimeoutError) as e:
+            self._reply_json(504, {"error": str(e)})
+            return
+        except Exception as e:  # engine-side failure — surfaced, not hung
+            self._reply_json(500, {"error": str(e)})
+            return
+        ttft_ms = None
+        if request.first_token_at is not None:
+            ttft_ms = round(
+                (request.first_token_at - request.submitted_at) * 1e3, 3)
+        self._reply_json(200, {
+            "request_id": request.request_id,
+            "tokens": tokens,
+            "replica": request.replica_id,
+            "requeues": request.requeues,
+            "ttft_ms": ttft_ms,
+        })
+
+
+class ServeServer:
+    """Owns the HTTP listener + the scheduler lifecycle."""
+
+    def __init__(self, scheduler: ReplicaScheduler,
+                 metrics: Optional[ServeMetrics] = None,
+                 request_timeout_s: Optional[float] = None):
+        self.scheduler = scheduler
+        self.metrics = metrics or scheduler.metrics
+        self.request_timeout_s = (
+            request_timeout_s if request_timeout_s is not None
+            else float(os.environ.get("HVD_SERVE_REQUEST_TIMEOUT_S", "120")))
+        self.httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
+        self.scheduler.start()
+        self.httpd = ThreadingHTTPServer((host, port), _ServeHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.scheduler = self.scheduler
+        self.httpd.metrics = self.metrics
+        self.httpd.request_timeout_s = self.request_timeout_s
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="hvd-serve-http")
+        self._thread.start()
+        bound = self.httpd.server_address[1]
+        get_logger().info("hvdserve listening on :%d (%d replica(s))",
+                          bound, len(self.scheduler.replicas))
+        return bound
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+        self.scheduler.stop()
+        self.metrics.maybe_emit_timeline(force=True)
+
+
+# ---------------------------------------------------------------------------
+# hvdserve CLI
+# ---------------------------------------------------------------------------
+
+def _build_adapter_factory(args):
+    """Model factory for the CLI: random-init weights unless a checkpoint
+    is supplied (serving quality needs trained weights; the random path
+    exists so the full serving stack is exercisable anywhere)."""
+    import jax
+
+    if args.model == "mlp":
+        import jax.numpy as jnp
+        from ..models import create_mlp
+        from .engine import MLPAdapter
+        vocab = args.vocab_size
+        mlp = create_mlp(features=(64, vocab))
+        params = mlp.init(jax.random.PRNGKey(args.seed),
+                          jnp.zeros((1, vocab)))["params"]
+        return lambda: MLPAdapter(mlp, params, vocab_size=vocab,
+                                  max_len=args.max_len)
+
+    import jax.numpy as jnp
+    from ..models import create_gpt2
+    from .engine import TransformerAdapter
+    size = args.model.split("-", 1)[1] if "-" in args.model else "small"
+    model = create_gpt2(size, scan_layers=False, dtype=jnp.float32,
+                        max_len=args.max_len)
+    cfg = model.cfg
+    if args.checkpoint:
+        from .. import checkpoint as ckpt
+        params, _, _, _ = ckpt.load_model(args.checkpoint)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(args.seed),
+            jnp.zeros((1, min(8, args.max_len)), jnp.int32))["params"]
+        get_logger().warning(
+            "hvdserve: no --checkpoint given — serving RANDOM weights "
+            "(stack exercise only)")
+    return lambda: TransformerAdapter(cfg, params, max_len=args.max_len)
+
+
+def run_commandline(argv=None) -> int:
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        prog="hvdserve",
+        description="Continuous-batching inference serving over the "
+                    "horovod_tpu data-parallel mesh (docs/serving.md)")
+    parser.add_argument("--model", default="mlp",
+                        help="mlp | gpt2-small | gpt2-medium | gpt2-large")
+    parser.add_argument("--checkpoint", default=None,
+                        help="checkpoint dir to load transformer params")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="serving replicas (default: "
+                             "HVD_SERVE_REPLICAS or num_slots//2)")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("HVD_SERVE_PORT",
+                                                   "8000")))
+    parser.add_argument("--max-batch", type=int, default=None,
+                        help="slots per replica (HVD_SERVE_MAX_BATCH)")
+    parser.add_argument("--max-len", type=int, default=256)
+    parser.add_argument("--vocab-size", type=int, default=256,
+                        help="mlp model vocab")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from .. import core as _core
+    if not _core.is_initialized():
+        from .. import init as hvd_init
+        hvd_init()
+    from .replica import build_replicas
+    scheduler = build_replicas(_build_adapter_factory(args),
+                               num_replicas=args.replicas,
+                               max_batch=args.max_batch)
+    if _core._state.timeline is not None:
+        scheduler.metrics.set_timeline(_core._state.timeline)
+    server = ServeServer(scheduler)
+    port = server.start(port=args.port)
+    print(f"hvdserve: listening on :{port} — POST /generate, GET /healthz, "
+          f"GET /metrics", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0

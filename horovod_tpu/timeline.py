@@ -45,6 +45,13 @@ RING_HOP = "RING_HOP"
 RING_KERNEL = "RING_KERNEL"
 RING_TRANSFER = "RING_TRANSFER"
 
+# Serving-plane counters (no reference analog — the reference is
+# training-only).  serve/metrics.py publishes engine statistics (tokens,
+# batch occupancy, queue depth, latency quantiles) as counter events under
+# SERVE/<component> so a serving run's trace charts them next to any
+# training-side op lifecycle in the same viewer.
+SERVE = "SERVE"
+
 # Static per-step collective census (no reference analog — the reference
 # only learns the collective set at runtime through negotiation; on TPU
 # the jaxpr checker reads it off the traced program, analysis/
@@ -161,6 +168,16 @@ class Timeline:
                        "ph": "C", "ts": self._ts_us(), "pid": self.rank,
                        "args": {"count": int(info.get("count", 0)),
                                 "bytes": int(info.get("bytes", 0))}})
+
+    def serve_counter(self, component: str, values: dict):
+        """Serving-engine counter sample (serve/metrics.py): ``values``
+        maps statistic name → number.  One counter event per sample —
+        occupancy/queue/token counters chart as stacked series in the
+        trace viewer under SERVE/<component>."""
+        self._put({"name": f"{SERVE}/{component}", "ph": "C",
+                   "ts": self._ts_us(), "pid": self.rank,
+                   "args": {k: (float(v) if isinstance(v, float) else int(v))
+                            for k, v in values.items()}})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,

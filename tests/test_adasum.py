@@ -141,6 +141,59 @@ def test_per_slice_adasum_equals_per_layer_adasum(hvd8):
     assert not np.allclose(joint, per_slice)
 
 
+def test_adasum_acc_dtype_knob_f64_beats_f32_on_bf16_islands(monkeypatch):
+    """HVD_ADASUM_ACC_DTYPE (TODO.md robustness item: the reference
+    accumulates its dot/norm islands in DOUBLE, adasum.h:357-363; ours
+    default to f32).  On bf16-quantized near-parallel gradients — the
+    regime where acoeff = 1 - dot/(2||a||^2) catastrophically cancels —
+    the f64 islands must land (much) closer to the f64 NumPy model of the
+    reference than the f32 islands do.
+
+    Inputs are bf16-quantized VALUES carried in f64 arrays so the output
+    cast (pair_combine returns a.dtype) does not quantize away the island
+    error being measured; x64 is enabled for the duration and restored."""
+    n = 1 << 15
+    rng = np.random.RandomState(11)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        # bf16-quantize adversarial mixed-magnitude, near-parallel pair.
+        scale = np.where(np.arange(n) % 2, 1e3, 1e-3)
+        a_bf = jnp.asarray(rng.randn(n) * scale, jnp.bfloat16)
+        b_bf = jnp.asarray(np.asarray(a_bf, np.float64) * 1.0003
+                           + rng.randn(n) * scale * 1e-4, jnp.bfloat16)
+        a = jnp.asarray(np.asarray(a_bf, np.float64))  # exact bf16 values
+        b = jnp.asarray(np.asarray(b_bf, np.float64))
+        expected = np_pair_combine(np.asarray(a), np.asarray(b))
+        ref_norm = np.linalg.norm(expected)
+
+        monkeypatch.setenv("HVD_ADASUM_ACC_DTYPE", "f32")
+        err32 = np.linalg.norm(
+            np.asarray(A.pair_combine(a, b), np.float64) - expected)
+        monkeypatch.setenv("HVD_ADASUM_ACC_DTYPE", "f64")
+        err64 = np.linalg.norm(
+            np.asarray(A.pair_combine(a, b), np.float64) - expected)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    # f32 islands visibly err on this regime; f64 islands match the
+    # reference model to near machine epsilon — orders of magnitude apart.
+    assert err32 > 0
+    assert err64 < err32 * 1e-2, (err32, err64)
+    assert err64 < 1e-9 * ref_norm, (err64, ref_norm)
+
+
+def test_adasum_acc_dtype_knob_guards(monkeypatch):
+    """f64 without x64 falls back to f32 (with a warning, not silence);
+    unknown values fail loudly."""
+    monkeypatch.setenv("HVD_ADASUM_ACC_DTYPE", "f64")
+    assert not jax.config.jax_enable_x64
+    assert A._acc_dtype() == jnp.float32  # x64 disabled → fallback
+    monkeypatch.setenv("HVD_ADASUM_ACC_DTYPE", "f16")
+    with pytest.raises(ValueError, match="HVD_ADASUM_ACC_DTYPE"):
+        A._acc_dtype()
+    monkeypatch.setenv("HVD_ADASUM_ACC_DTYPE", "f32")
+    assert A._acc_dtype() == jnp.float32
+
+
 def test_per_slice_adasum_subset_members(hvd8):
     """per_slice plumbing through the gathered fallback: a 3-member (non
     power-of-two) process-set Adasum over a stacked leaf must match the

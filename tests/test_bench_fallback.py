@@ -46,7 +46,9 @@ def _bench_env(tag, **overrides):
     # _last_good_path away from the records these tests plant.
     for var in ("BENCH_MODEL", "BENCH_FAST_STEM", "BENCH_SMOKE",
                 "BENCH_PROFILE", "BENCH_BERT_BATCH", "BENCH_BERT_ATTN",
-                "BENCH_BERT_MLMPOS", "BENCH_GPT2_BATCH"):
+                "BENCH_BERT_MLMPOS", "BENCH_GPT2_BATCH",
+                "BENCH_SERVE_REQUESTS", "BENCH_SERVE_NEWTOKENS",
+                "BENCH_SERVE_REPLICAS"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -138,6 +140,44 @@ def test_no_prior_capture_fails_with_clear_message():
     assert r.returncode != 0
     assert not _json_lines(r.stdout)  # nothing to emit — and says so
     assert "no prior capture" in r.stderr
+
+
+def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
+    """ISSUE 4 satellite: BENCH_MODEL=serve runs the continuous-batching
+    serving microbench (bench.bench_serve) end-to-end on CPU under
+    BENCH_SMOKE shapes and the emitted record carries the throughput AND
+    latency keys the serving story is judged on — tokens/sec, the
+    TTFT / per-output-token split, and achieved batch occupancy."""
+    tag = "pytestservesmoke"
+    path = os.path.join(_REPO, "artifacts",
+                        f"last_bench_serve_smoke_{tag}.json")
+    env = _bench_env(tag, JAX_PLATFORMS="cpu", BENCH_MODEL="serve",
+                     BENCH_SMOKE="1", BENCH_PROBE_BUDGET_S="60",
+                     BENCH_PROBE_TIMEOUT_S="30")
+    try:
+        r = subprocess.run([sys.executable, _BENCH], env=env,
+                           capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        records = _json_lines(r.stdout)
+        assert records, r.stdout
+        last = records[-1]
+        assert last["metric"] == "serve_tokens_per_sec"
+        assert last["unit"] == "tokens/sec"
+        assert last["value"] > 0
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "token_step_p50_ms",
+                    "token_step_p99_ms", "occupancy_mean",
+                    "occupancy_max"):
+            assert key in last, f"{key} missing from serve record: {last}"
+        # Continuous batching demonstrably engaged even in the smoke run.
+        assert last["occupancy_max"] > 1
+        assert last["requests"]["ok"] >= 16
+        with open(path) as f:  # persisted under the serve+smoke keying
+            assert json.load(f)["metric"] == "serve_tokens_per_sec"
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 def test_fresh_capture_supersedes_stale(tmp_path):

@@ -1,0 +1,192 @@
+"""runner/http_server.py concurrent-waiter coverage (ISSUE 4 satellite).
+
+The Python KV server becomes the serving control plane's fallback
+(HVD_TPU_KV_SERVER=python; serve/replica.py polls the ``preempt`` scope
+through it), and its waiter machinery — the per-scope conditions behind
+``_cond``/``_notify``, the ``_put_wait`` announce-then-await fold, and the
+``_gc_cond`` delete-while-waiting path — had no dedicated concurrency
+test.  Every test here forces the PYTHON backend explicitly: the native
+C++ server has its own test coverage and none of these code paths.
+"""
+
+import base64
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.http_server import KVStoreClient, KVStoreServer
+
+
+@pytest.fixture()
+def py_kv(monkeypatch):
+    """A running PYTHON-backend KV server + a client factory."""
+    monkeypatch.setenv("HVD_TPU_KV_SERVER", "python")
+    server = KVStoreServer()
+    port = server.start(0)
+    assert server.httpd is not None  # really the Python backend
+    yield server, (lambda: KVStoreClient("127.0.0.1", port))
+    server.stop()
+
+
+def test_long_poll_wakes_only_its_scope(py_kv):
+    """A PUT must wake ITS scope's waiters promptly while waiters on other
+    scopes sleep out their windows untouched (the per-scope-condition
+    design in _cond's docstring — one global condition would wake all)."""
+    server, mk_client = py_kv
+    n_scopes = 8
+    results, latencies = {}, {}
+    barrier = threading.Barrier(n_scopes + 1)
+
+    def waiter(i):
+        c = mk_client()
+        barrier.wait()
+        t0 = time.monotonic()
+        out = c.get(f"scope{i}", "key", wait=10.0)
+        latencies[i] = time.monotonic() - t0
+        results[i] = out
+
+    threads = [threading.Thread(target=waiter, args=(i,))
+               for i in range(n_scopes)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.1)  # let every waiter park on its condition
+    writer = mk_client()
+    for i in range(n_scopes):
+        writer.put(f"scope{i}", "key", f"v{i}".encode())
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {i: f"v{i}".encode() for i in range(n_scopes)}
+    assert all(lat < 8.0 for lat in latencies.values()), latencies
+
+
+def test_put_wait_fanout_all_waiters_get_verdict(py_kv):
+    """The negotiation pattern at scale: N workers fold announce+await
+    into one put_wait each; the coordinator collects all N announcements
+    with a min-keys scan long-poll, then publishes ONE verdict that must
+    release every parked put_wait."""
+    server, mk_client = py_kv
+    n = 16
+    verdicts = [None] * n
+
+    def worker(i):
+        c = mk_client()
+        verdicts[i] = c.put_wait("requests", f"rank{i}",
+                                 f"req{i}".encode(),
+                                 "verdicts", "round0", wait=20.0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    coordinator = mk_client()
+    announced = coordinator.scan("requests", wait=20.0, min_keys=n)
+    assert len(announced) == n  # min-keys long-poll saw every announce
+    assert announced["rank3"] == b"req3"
+    coordinator.put("verdicts", "round0", b"APPROVED")
+    for t in threads:
+        t.join(timeout=30)
+    assert verdicts == [b"APPROVED"] * n
+
+
+def test_scope_delete_wakes_waiters_who_reissue(py_kv):
+    """_gc_cond contract: deleting a scope must WAKE its parked waiters
+    (they re-check, time out their chunk, re-issue) — and a key published
+    AFTER the delete (on the scope's fresh condition) must still reach a
+    re-issued waiter instead of stranding it on the popped condition."""
+    server, mk_client = py_kv
+    got = []
+
+    def waiter():
+        c = mk_client()
+        # First long-poll chunk may be cut short by the delete (404);
+        # the client re-issues like the real negotiation loop does.
+        for _ in range(20):
+            out = c.get("doomed", "answer", wait=1.0)
+            if out is not None:
+                got.append(out)
+                return
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    admin = mk_client()
+    admin.put("doomed", "other_key", b"x")  # materialize the scope
+    admin.delete_scope("doomed")            # pops scope AND its condition
+    time.sleep(0.1)
+    admin.put("doomed", "answer", b"42")    # NEW condition, same name
+    t.join(timeout=30)
+    assert got == [b"42"]
+
+
+def test_put_wait_timeout_returns_none_but_stores_value(py_kv):
+    server, mk_client = py_kv
+    c = mk_client()
+    t0 = time.monotonic()
+    out = c.put_wait("announce", "k", b"payload", "never", "coming",
+                     wait=0.3)
+    assert out is None
+    assert time.monotonic() - t0 < 5.0
+    assert c.get("announce", "k") == b"payload"  # the put half landed
+
+
+def test_concurrent_mixed_load_no_lost_updates(py_kv):
+    """Thundering-herd smoke: concurrent batch-puts, long-poll gets and
+    scans across shared scopes — every writer's full payload must be
+    readable afterwards and no thread may wedge (the cache_lock +
+    per-scope-condition invariants under real thread interleaving)."""
+    server, mk_client = py_kv
+    n_writers, n_keys = 8, 25
+    errors = []
+
+    def writer(w):
+        try:
+            c = mk_client()
+            c.put_batch(f"bulk{w % 4}",
+                        {f"w{w}k{k}": f"{w}:{k}".encode()
+                         for k in range(n_keys)})
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(repr(e))
+
+    def poller(w):
+        try:
+            c = mk_client()
+            out = c.get(f"bulk{w % 4}", f"w{w}k0", wait=15.0)
+            if out != f"{w}:0".encode():
+                errors.append(f"poller {w} got {out!r}")
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=poller, args=(w,))
+               for w in range(n_writers)]
+    threads += [threading.Thread(target=writer, args=(w,))
+                for w in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    c = mk_client()
+    for w in range(n_writers):
+        scope = c.scan(f"bulk{w % 4}")
+        for k in range(n_keys):
+            assert scope[f"w{w}k{k}"] == f"{w}:{k}".encode()
+
+
+def test_server_side_put_does_notify_waiters(py_kv):
+    """KVStoreServer.put (the launcher's in-process write path) must wake
+    HTTP long-pollers — the rendezvous publishes the host plan this way
+    while workers long-poll for it."""
+    server, mk_client = py_kv
+    out = {}
+
+    def waiter():
+        out["v"] = mk_client().get("rendezvous", "rank/0", wait=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    server.put("rendezvous", "rank/0", b"slotinfo")
+    t.join(timeout=30)
+    assert out["v"] == b"slotinfo"

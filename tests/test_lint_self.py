@@ -39,6 +39,15 @@ def test_lint_covers_the_whole_tree():
     assert len(files) > 50
     assert any(f.endswith("optimizer.py") for f in files)
     assert any(f.endswith("mnist_mlp.py") for f in files)
+    # The serve/ subsystem (ISSUE 4) must stay inside the gate's walk —
+    # a skip-list regression here would let serving-path antipatterns
+    # land unlinted.
+    serve_files = [f for f in files
+                   if os.sep + os.path.join("serve", "") in f]
+    for mod in ("engine.py", "batcher.py", "replica.py", "server.py",
+                "metrics.py"):
+        assert any(f.endswith(os.path.join("serve", mod))
+                   for f in serve_files), f"serve/{mod} not linted"
     assert not any("__pycache__" in f for f in files)
 
 
