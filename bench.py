@@ -44,7 +44,12 @@ BASELINE_IMG_S_PER_DEV = 1656.82 / 16  # docs/benchmarks.rst:40-42
 KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "BENCH_BERT_MLMPOS": "20", "BENCH_GPT2_BATCH": "8",
                  "BENCH_SERVE_REQUESTS": "64", "BENCH_SERVE_NEWTOKENS": "32",
-                 "BENCH_SERVE_REPLICAS": "2"}
+                 "BENCH_SERVE_REPLICAS": "2",
+                 "BENCH_SERVE_SLOT_BATCH": "4",
+                 "HVD_SERVE_BLOCK_TOKENS": "16",
+                 "HVD_SERVE_PREFILL_CHUNK": "64",
+                 "HVD_SERVE_PREFIX_CACHE": "1",
+                 "HVD_SERVE_KV_MODE": "auto"}
 
 
 def _last_good_path():
@@ -306,18 +311,26 @@ def bench_serve():
     """BENCH_MODEL=serve: continuous-batching serving microbench
     (horovod_tpu/serve, docs/serving.md).
 
-    Stands up the replica scheduler over process sets, floods it with
-    concurrent generation requests through the real batcher/engine path
-    (HTTP is exercised by tests/test_serve_e2e.py; the bench measures the
-    decode plane), and reports aggregate tokens/sec with the latency
-    split the serving literature standardizes on: TTFT (prefill wait +
-    compute) and per-output-token step latency, plus achieved batch
-    occupancy — the continuous-batching statistic (occupancy ~1 would
-    mean the engine degenerated into request-level batching)."""
+    Main storm: the replica scheduler over process sets under concurrent
+    generation load through the real batcher/engine path (HTTP is
+    exercised by tests/test_serve_e2e.py; the bench measures the decode
+    plane) — aggregate tokens/sec, TTFT / per-output-token latency split,
+    achieved batch occupancy.
+
+    Three paged-cache arms (ISSUE 5 acceptance), each with the identical
+    prompts run on both engine configs so exactness is checked in-band:
+
+    * ``paged``   — paged vs slot engine at a FIXED cache-memory budget
+      (``BENCH_SERVE_SLOT_BATCH`` × max_len token positions) on a
+      mixed-length storm: concurrent sequences admitted + tokens/s;
+    * ``chunked`` — decode token_step p99 while max_len prompts prefill,
+      chunked (``HVD_SERVE_PREFILL_CHUNK``) vs unchunked;
+    * ``prefix``  — shared-prefix storm: prefix-cache hit rate and block
+      allocations saved."""
     import threading
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
-    from horovod_tpu.serve import (Request, ServeMetrics,
+    from horovod_tpu.serve import (InferenceEngine, Request, ServeMetrics,
                                    TransformerAdapter, build_replicas)
 
     smoke = os.environ.get("BENCH_SMOKE") == "1"
@@ -327,8 +340,19 @@ def bench_serve():
                                     KNOB_DEFAULTS["BENCH_SERVE_NEWTOKENS"]))
     replicas = int(os.environ.get("BENCH_SERVE_REPLICAS",
                                   KNOB_DEFAULTS["BENCH_SERVE_REPLICAS"]))
+    block_tokens = int(os.environ.get(
+        "HVD_SERVE_BLOCK_TOKENS", KNOB_DEFAULTS["HVD_SERVE_BLOCK_TOKENS"]))
+    chunk = int(os.environ.get(
+        "HVD_SERVE_PREFILL_CHUNK",
+        KNOB_DEFAULTS["HVD_SERVE_PREFILL_CHUNK"]))
+    slot_batch = int(os.environ.get(
+        "BENCH_SERVE_SLOT_BATCH", KNOB_DEFAULTS["BENCH_SERVE_SLOT_BATCH"]))
+    prefix_on = os.environ.get(
+        "HVD_SERVE_PREFIX_CACHE",
+        KNOB_DEFAULTS["HVD_SERVE_PREFIX_CACHE"]) not in ("0", "false")
     if smoke:
         n_requests, new_tokens = min(n_requests, 16), min(new_tokens, 8)
+        slot_batch, chunk = min(slot_batch, 2), min(chunk, 8)
     cfg = TransformerConfig(
         vocab_size=256, causal=True, dtype=jnp.float32, scan_layers=False,
         **({"num_layers": 2, "num_heads": 2, "d_model": 64, "d_ff": 128,
@@ -346,7 +370,8 @@ def bench_serve():
     # so running the identical storm once first compiles every (count,
     # prompt-length) bucket the workload can hit — a single warm request
     # would leave most buckets to compile inside the timed window.
-    adapters = [TransformerAdapter(cfg, params) for _ in range(replicas)]
+    adapters = [TransformerAdapter(cfg, params, block_tokens=block_tokens)
+                for _ in range(replicas)]
 
     def run_storm(sched):
         requests = [Request(p, max_new_tokens=new_tokens) for p in prompts]
@@ -376,6 +401,170 @@ def bench_serve():
     sched.stop()
     total_tokens = sum(len(o) for o in outs)
     snap = metrics.snapshot()
+    kv_mode = sched.replicas[0].engine.kv_mode
+
+    def engine_storm(engine, storm_prompts, toks):
+        reqs = [Request(p, max_new_tokens=toks) for p in storm_prompts]
+        for r in reqs:
+            engine.batcher.submit(r)
+        return [r.result(timeout=600) for r in reqs]
+
+    def timed_storm(make_engine, storm_prompts, toks):
+        """Warm run (compiles every bucket on the shared adapter), then
+        the measured run on a fresh engine; returns (outs, dt, snapshot,
+        kv stats)."""
+        warm = make_engine().start()
+        engine_storm(warm, storm_prompts, toks)
+        warm.stop()
+        eng = make_engine().start()
+        eng.metrics.started_at = time.monotonic()
+        t0 = time.perf_counter()
+        outs = engine_storm(eng, storm_prompts, toks)
+        dt = time.perf_counter() - t0
+        stats = eng.kv_stats()
+        eng.stop()
+        return outs, dt, eng.metrics.snapshot(), stats
+
+    # -- arm 1: paged vs slot at a FIXED cache-memory budget ------------------
+    # Budget = slot_batch × max_len token positions.  The slot engine
+    # spends it on slot_batch full-length reservations; the paged engine
+    # shares the same positions as blocks, so the mixed-(short-)length
+    # storm packs many more concurrent sequences into the same HBM.
+    budget_tokens = slot_batch * cfg.max_len
+    mixed_prompts = [rng.randint(0, 256, size=(
+        int(rng.randint(4, max(6, cfg.max_len // 4))),)).tolist()
+        for _ in range(n_requests)]
+    slot_adapter = TransformerAdapter(cfg, params)
+    slot_outs, slot_dt, slot_snap, _ = timed_storm(
+        lambda: InferenceEngine(slot_adapter, max_batch=slot_batch,
+                                kv_mode="slot", metrics=ServeMetrics(),
+                                replica_id="bench-slot"),
+        mixed_prompts, new_tokens)
+    paged_adapter = TransformerAdapter(cfg, params,
+                                       block_tokens=block_tokens)
+    # 4x the slot width: enough rows for the block-bound concurrency the
+    # mixed storm reaches.  (On this CPU harness decode is dense compute,
+    # so tokens/s tracks FLOPs and the paged win is the CONCURRENCY held
+    # in the same HBM budget — the admit_ratio metric; a real TPU decode
+    # is memory-bound and converts that occupancy into throughput.)
+    paged_batch = min(slot_batch * 4, 64)
+    paged_outs, paged_dt, paged_snap, paged_kv = timed_storm(
+        lambda: InferenceEngine(paged_adapter, max_batch=paged_batch,
+                                kv_mode="paged",
+                                num_blocks=budget_tokens // block_tokens,
+                                prefill_chunk=chunk,
+                                prefix_cache=prefix_on,
+                                metrics=ServeMetrics(),
+                                replica_id="bench-paged"),
+        mixed_prompts, new_tokens)
+    slot_tok = sum(len(o) for o in slot_outs)
+    paged_tok = sum(len(o) for o in paged_outs)
+    arm_paged = {
+        "budget_tokens": budget_tokens,
+        "slot_admitted_concurrent": slot_snap["occupancy"]["max"],
+        "admitted_concurrent": paged_snap["occupancy"]["max"],
+        "admit_ratio": round(paged_snap["occupancy"]["max"]
+                             / max(slot_snap["occupancy"]["max"], 1), 3),
+        "slot_tokens_per_sec": round(slot_tok / slot_dt, 2),
+        "tokens_per_sec": round(paged_tok / paged_dt, 2),
+        "speedup": round((paged_tok / paged_dt)
+                         / max(slot_tok / slot_dt, 1e-9), 3),
+        "outputs_match": paged_outs == slot_outs,
+    }
+
+    # -- arm 2: chunked vs unchunked under a long-prompt storm ----------------
+    # Long prompts are injected SEQUENTIALLY against a steady decode
+    # background: each unchunked whole-prompt prefill lands in one
+    # inter-decode gap, and repeated injections keep those gaps above the
+    # p99 sample threshold.
+    # Enough long injections that their inter-decode gaps clear the p99
+    # sample threshold, few enough that the background decoders outlive
+    # the whole storm.
+    n_long = 2 if smoke else 10
+    bg_tokens = 40 if smoke else 96
+    bg_prompts = [rng.randint(0, 256, size=(4,)).tolist()
+                  for _ in range(max(2, slot_batch))]
+    long_len = cfg.max_len - 12
+    long_prompts = [rng.randint(0, 256, size=(long_len,)).tolist()
+                    for _ in range(n_long)]
+    chunk_adapter = TransformerAdapter(cfg, params,
+                                       block_tokens=block_tokens)
+    interf_blocks = (len(bg_prompts) + n_long + 2) * \
+        chunk_adapter.max_blocks_per_seq
+
+    def interference(prefill_chunk):
+        def storm():
+            eng = InferenceEngine(chunk_adapter, max_batch=8,
+                                  kv_mode="paged", num_blocks=interf_blocks,
+                                  prefill_chunk=prefill_chunk,
+                                  prefix_cache=False,
+                                  metrics=ServeMetrics(),
+                                  replica_id="bench-interf").start()
+            bg = [Request(p, max_new_tokens=bg_tokens) for p in bg_prompts]
+            for r in bg:
+                eng.batcher.submit(r)
+            # Let the background decoders reach steady state, then land
+            # the long prompts one after another mid-flight.
+            deadline = time.monotonic() + 60
+            while eng.metrics.snapshot()["decode_steps"] < 3 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            outs = []
+            for p in long_prompts:
+                r = Request(p, max_new_tokens=4)
+                eng.batcher.submit(r)
+                outs.append(r.result(timeout=600))
+            outs.extend(r.result(timeout=600) for r in bg)
+            p99 = eng.metrics.snapshot()["token_step"]["p99_ms"]
+            eng.stop()
+            return p99, outs
+        storm()  # warm: compile this config's chunk buckets
+        return storm()
+
+    chunked_p99, chunked_outs = interference(chunk)
+    unchunked_p99, unchunked_outs = interference(0)
+    arm_chunked = {
+        "prefill_chunk": chunk,
+        "long_prompt_len": long_len,
+        "token_step_p99_ms": chunked_p99,
+        "unchunked_token_step_p99_ms": unchunked_p99,
+        "p99_ratio": round(unchunked_p99 / max(chunked_p99, 1e-9), 3),
+        "outputs_match": chunked_outs == unchunked_outs,
+    }
+
+    # -- arm 3: prefix reuse --------------------------------------------------
+    shared = rng.randint(0, 256,
+                         size=(cfg.max_len // 2,)).tolist()
+    prefix_prompts = [shared + rng.randint(0, 256, size=(3,)).tolist()
+                      for _ in range(max(4, slot_batch * 2))]
+    prefix_adapter = TransformerAdapter(cfg, params,
+                                        block_tokens=block_tokens)
+
+    def prefix_storm():
+        # Leader first: its completed prompt blocks populate the prefix
+        # cache, then the rest of the storm maps them (a fully-concurrent
+        # first wave would look up before anything registered).
+        eng = InferenceEngine(prefix_adapter, max_batch=8,
+                              kv_mode="paged", num_blocks=interf_blocks,
+                              prefill_chunk=chunk, prefix_cache=True,
+                              metrics=ServeMetrics(),
+                              replica_id="bench-prefix").start()
+        engine_storm(eng, prefix_prompts[:1], 4)
+        engine_storm(eng, prefix_prompts[1:], 4)
+        stats = eng.kv_stats()
+        eng.stop()
+        return stats
+
+    prefix_storm()  # warm the (count, chunk) compile buckets
+    prefix_kv = prefix_storm()
+    arm_prefix = {
+        "enabled": prefix_on,
+        "hit_rate": round(prefix_kv["prefix_hit_rate"], 4),
+        "hit_tokens": prefix_kv["prefix_hit_tokens"],
+        "cow_copies": prefix_kv["cow"],
+        "evictions": prefix_kv["evictions"],
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -384,8 +573,13 @@ def bench_serve():
         "config": f"{replicas} replica(s) x batch "
                   f"{os.environ.get('HVD_SERVE_MAX_BATCH', '8')}, "
                   f"{n_requests} reqs x {new_tokens} tokens, "
-                  f"L{cfg.num_layers} d{cfg.d_model} greedy f32"
+                  f"L{cfg.num_layers} d{cfg.d_model} greedy f32 "
+                  f"{kv_mode} bt{block_tokens} chunk{chunk}"
                   + (" SMOKE" if smoke else ""),
+        "kv_mode": kv_mode,
+        "block_tokens": block_tokens,
+        "prefill_chunk": chunk,
+        "prefix_cache": prefix_on,
         "ttft_p50_ms": snap["ttft"]["p50_ms"],
         "ttft_p99_ms": snap["ttft"]["p99_ms"],
         "token_step_p50_ms": snap["token_step"]["p50_ms"],
@@ -393,6 +587,10 @@ def bench_serve():
         "occupancy_mean": snap["occupancy"]["mean"],
         "occupancy_max": snap["occupancy"]["max"],
         "requests": snap["requests"],
+        "token_split": snap["token_split"],
+        "paged": arm_paged,
+        "chunked": arm_chunked,
+        "prefix": arm_prefix,
     })
 
 

@@ -248,6 +248,7 @@ def _fusion_pack(*ts):
 def _fused_allreduce(tensors: Sequence, op,
                      prescale_factor: float = 1.0,
                      postscale_factor: float = 1.0,
+                     compression=Compression.none,
                      process_set: ProcessSet = global_process_set) -> List:
     """Eager fused allreduce over one FLAT fusion buffer: device-side pack
     (MemcpyInFusionBuffer, operations.cc:519 — here an eager device-side
@@ -258,6 +259,12 @@ def _fused_allreduce(tensors: Sequence, op,
     array assembly instead of one per tensor — the reference's tensor-
     fusion data path, which is where the eager dispatch time went.
 
+    ``compression`` (fp16/bf16) is applied ONCE to the packed buffer —
+    the planner's buckets are same-dtype, and a cast is elementwise, so
+    compress(concat(ts)) == concat(compress(t) for ts) and the per-tensor
+    grouped path's numerics are preserved with one cast + one collective
+    per bucket instead of one pair per tensor (docs/tensor_fusion.md).
+
     All tensors must share one dtype (the fusion planner only buckets
     same-dtype entries, csrc PlanFusion / controller.cc:901)."""
     rop = ReduceOp(op)
@@ -265,11 +272,10 @@ def _fused_allreduce(tensors: Sequence, op,
     members = _members(process_set)
     eng = _engine()
     ts = [jnp.asarray(t) for t in tensors]
-    dtype = ts[0].dtype
     shapes = [t.shape for t in ts]
     sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
     offsets = np.concatenate([[0], np.cumsum(sizes)])
-    flat = _fusion_pack(*ts)
+    flat, cctx = compression.compress(_fusion_pack(*ts))
 
     def body(x):
         return C.allreduce(x, rop, axis_name=axis, members=members,
@@ -282,10 +288,11 @@ def _fused_allreduce(tensors: Sequence, op,
 
     out = eng.run("allreduce", body, [flat],
                   (int(rop), members, prescale_factor, postscale_factor),
-                  single, name=f"fusedbuf.{dtype}.{int(offsets[-1])}",
+                  single, name=f"fusedbuf.{flat.dtype}.{int(offsets[-1])}",
                   op_id=int(rop), prescale=prescale_factor,
                   postscale=postscale_factor,
                   **_wire_ps(process_set))[0]
+    out = compression.decompress(out, cctx)  # ctx = pre-wire flat dtype
     return [out[int(a):int(b)].reshape(s)
             for a, b, s in zip(offsets[:-1], offsets[1:], shapes)]
 

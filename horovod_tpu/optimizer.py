@@ -250,18 +250,30 @@ def _allreduce_tree(grads, op, compression, prescale, postscale, process_set,
         total_bytes = sum(e[2] for e in entries)
         # True multi-process dispatch packs each bucket into ONE flat
         # fusion buffer (single device transfer + single collective — the
-        # reference's fusion-buffer data path, operations.cc:519).
-        # Emulated mode keeps grouped dispatch: its tensors are per-rank
-        # stacks the flat packing would mangle, and it has no per-tensor
-        # assembly cost to amortize.
+        # reference's fusion-buffer data path, operations.cc:519), with
+        # fp16/bf16 compression applied once to the packed buffer (the
+        # planner's buckets are same-dtype, so one cast covers the whole
+        # bucket — the per-tensor grouped compress path documented as the
+        # gap in docs/tensor_fusion.md until ISSUE 5).  Emulated mode
+        # keeps grouped dispatch: its tensors are per-rank stacks the
+        # flat packing would mangle, and it has no per-tensor assembly
+        # cost to amortize.
         topo = _core._state.topology
+        # Only the known-ELEMENTWISE compressors may compress the packed
+        # buffer once (compress(concat) == concat(compress) holds for
+        # casts only): a custom Compressor subclass (e.g. per-tensor
+        # scaled quantization) keeps the per-tensor grouped path so its
+        # per-tensor semantics survive.
         use_fused = (topo is not None and topo.size > 1
                      and not topo.emulated
-                     and compression is Compression.none)
+                     and compression in (Compression.none,
+                                         Compression.fp16,
+                                         Compression.bf16))
         for bucket in buckets:
             if use_fused:
                 outs = _ops._fused_allreduce(
                     [leaves[i] for i in bucket], op=op,
+                    compression=compression,
                     prescale_factor=prescale, postscale_factor=postscale,
                     process_set=process_set)
             else:

@@ -9,8 +9,12 @@ rank-loss reports, and ``timeline`` counters.
 
 Layers (docs/serving.md has the architecture):
 
-* :mod:`engine`  — slot-based KV cache + iteration-level decode loop;
-* :mod:`batcher` — bounded queue, size/deadline triggers, shape buckets;
+* :mod:`blocks`  — paged KV block pool, per-sequence block tables,
+  full-block prefix cache with copy-on-write;
+* :mod:`engine`  — paged (default) / slot KV cache, chunked prefill,
+  iteration-level decode loop;
+* :mod:`batcher` — bounded queue, size/deadline triggers, shape buckets,
+  block-budget admission;
 * :mod:`replica` — process-set replicas, least-loaded routing, failover;
 * :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
   ``hvdserve`` CLI;
@@ -29,6 +33,9 @@ Quickstart (CPU-exercisable end to end)::
 from .batcher import (  # noqa: F401
     DeadlineExceededError, DynamicBatcher, QueueFullError, Request,
     bucket_requests, prompt_bucket,
+)
+from .blocks import (  # noqa: F401
+    BlockManager, NoFreeBlocksError, chain_hashes,
 )
 from .engine import (  # noqa: F401
     InferenceEngine, MLPAdapter, ModelAdapter, TransformerAdapter,

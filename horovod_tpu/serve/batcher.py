@@ -22,6 +22,11 @@ Shape bucketing: prompt lengths are padded up to power-of-two buckets
 (floor ``HVD_SERVE_BUCKET_MIN``) so the engine compiles one prefill per
 bucket instead of one per length — ``bucket_requests`` groups an admitted
 set by bucket and the engine runs one prefill per group.
+
+Block budget (paged engine, docs/serving.md): ``get_admission`` also
+accepts a resource budget + per-request cost — free KV blocks — and
+admits the FIFO prefix that fits, so admission is bounded by actual
+cache memory instead of slot count.
 """
 
 from __future__ import annotations
@@ -193,12 +198,46 @@ class DynamicBatcher:
             (expired if r.expired(now) else kept).append(r)
         self._queue = kept
 
+    def _take(self, free_slots: int, budget: Optional[int], cost,
+              hard_cap: Optional[int]) -> List[Request]:
+        # Caller holds the lock.  FIFO prefix bounded by BOTH the free
+        # slot count and the caller's resource budget (free KV blocks in
+        # the paged engine): the walk stops at the first request the
+        # budget cannot cover — never skips past the head, so a cheap
+        # late request cannot starve an expensive early one.  Requests
+        # whose cost exceeds ``hard_cap`` (the pool's total capacity) are
+        # taken regardless: no amount of waiting helps, and the engine
+        # fails them loudly at admission.
+        taken: List[Request] = []
+        remaining = budget
+        while self._queue and len(taken) < free_slots:
+            r = self._queue[0]
+            if cost is not None:
+                c = cost(r)
+                if hard_cap is not None and c > hard_cap:
+                    taken.append(self._queue.pop(0))
+                    continue
+                if remaining is not None and c > remaining:
+                    break
+                if remaining is not None:
+                    remaining -= c
+            taken.append(self._queue.pop(0))
+        return taken
+
     def get_admission(self, free_slots: int,
-                      block_s: float = 0.0) -> List[Request]:
+                      block_s: float = 0.0,
+                      budget: Optional[int] = None,
+                      cost=None,
+                      hard_cap: Optional[int] = None) -> List[Request]:
         """Up to ``free_slots`` requests, honoring the size/deadline
         triggers.  ``block_s`` > 0 waits that long for the triggers when
         the queue cannot fire them yet (the engine blocks when idle and
-        polls with 0 between decode steps)."""
+        polls with 0 between decode steps).
+
+        ``budget``/``cost``/``hard_cap`` account a second resource beyond
+        slots (the paged engine's free KV blocks, docs/serving.md): the
+        admitted set is the FIFO prefix whose summed ``cost(request)``
+        fits ``budget`` (see ``_take``)."""
         if free_slots <= 0:
             return []
         deadline = time.monotonic() + block_s
@@ -212,9 +251,16 @@ class DynamicBatcher:
                         oldest_age = now - self._queue[0].submitted_at
                         if (len(self._queue) >= free_slots
                                 or oldest_age >= self.max_wait_s):
-                            taken = self._queue[:free_slots]
-                            del self._queue[:free_slots]
-                            return taken
+                            taken = self._take(free_slots, budget, cost,
+                                               hard_cap)
+                            if taken:
+                                return taken
+                            # Head too expensive for the current budget:
+                            # nothing admits this round — the engine
+                            # retries after the next decode step frees
+                            # blocks (a condition wait can't observe
+                            # block frees, only submits).
+                            return []
                         # Triggers not fired: wait only until the oldest
                         # ages out (never past the caller's budget).
                         wait = min(self.max_wait_s - oldest_age,

@@ -1,27 +1,43 @@
 """Continuous-batching inference engine over the repo's ``models/``.
 
 No reference analog — the reference ends at the optimizer step.  The design
-is Orca's iteration-level scheduling (OSDI '22) on the vLLM observation
-(SOSP '23) that the KV cache is the memory object to manage:
+is Orca's iteration-level scheduling (OSDI '22) with vLLM's block-paged KV
+storage (Kwon et al., SOSP '23) and Sarathi-Serve's chunked prefill
+(Agrawal et al., OSDI '24):
 
-* **slot-based KV cache** — one pre-allocated cache of
-  ``[L, max_batch, max_len, H, Dh]`` per replica; a sequence owns one batch
-  *slot* for its lifetime and is retired at token granularity, so a short
-  answer never waits for a long one sharing its batch;
-* **admission between decode steps** — every loop iteration first admits
-  new requests into free slots (prefill), then advances EVERY active
-  sequence one token (decode), so the batch composition changes at
-  token-step granularity (continuous batching);
-* **bucketed compilation** — prefill jits once per (padded request count,
-  padded prompt length) power-of-two bucket and decode jits exactly once
-  (full ``max_batch``), so steady-state serving never recompiles.
+* **paged KV cache** (default) — the cache is a pool of fixed-size blocks
+  (``HVD_SERVE_BLOCK_TOKENS`` positions each, serve/blocks.BlockManager);
+  a sequence holds exactly the blocks its tokens occupy and addresses
+  them through a per-sequence block table, so admission is bounded by
+  *free blocks*, not by ``max_batch × max_len`` pre-reservation.  The
+  attention programs gather K/V with ``jnp.take`` over the block tables —
+  the CPU-exercisable form of PagedAttention, shaped so a Pallas gather
+  kernel can replace the take+einsum later without touching scheduling;
+* **chunked prefill** — long prompts stream through the per-iteration
+  token budget ``HVD_SERVE_PREFILL_CHUNK``, so every iteration still runs
+  admit → prefill-chunk → decode and a ``max_len`` prompt never stalls
+  in-flight decodes for a whole prefill (decode token-step p99 stays flat
+  while prompts stream in);
+* **prefix caching** — full prompt blocks are content-hashed; a request
+  sharing a cached prefix maps the same physical blocks and skips their
+  prefill (copy-on-write protects shared blocks from writes);
+* **slot mode** (``kv_mode="slot"``) — the PR-3 contiguous
+  ``[L, max_batch, max_len, H, Dh]`` layout is kept for adapters without
+  a paged interface and as the bench baseline (``BENCH_MODEL=serve``
+  measures paged-vs-slot at a fixed cache-memory budget);
+* **bucketed compilation** — chunk prefill jits once per (padded request
+  count, padded chunk length) power-of-two bucket and paged decode jits
+  exactly once, so steady-state serving never recompiles.
 
 Exactness: decoding is greedy (argmax) and every per-sequence computation
-is row-independent inside the batch — padded cache positions are masked to
-``-1e30`` before the softmax (weight exactly 0) and inactive rows only
-ever scatter into their own cache row — so the tokens a request receives
-are bit-identical whether it ran alone or packed in a full batch.  The e2e
-test pins batched-vs-single parity on this.
+is row-independent inside the batch — cache positions beyond a sequence's
+length are masked to ``-1e30`` before the softmax (weight exactly 0),
+block-table holes use an out-of-bounds sentinel (scatter drops the write,
+gather clamps and the mask zeroes the read) — so the tokens a request
+receives are bit-identical whether it ran alone, packed in a full batch,
+prefilled in one shot or in chunks, or resumed on another replica.  Tests
+pin batched==single under every mode, including block-boundary prompt
+lengths.
 
 Model support: the ``models/`` Transformer (dense causal attention,
 ``TransformerAdapter`` — stacked ``scan_layers`` checkpoints are unstacked
@@ -43,6 +59,7 @@ import numpy as np
 from ..utils import get_logger
 from .batcher import (DynamicBatcher, Request, bucket_requests,
                       prompt_bucket)
+from .blocks import BlockManager, NoFreeBlocksError, chain_hashes
 from .metrics import ServeMetrics
 
 
@@ -60,9 +77,12 @@ def _next_pow2(n: int, floor: int = 1) -> int:
 class ModelAdapter:
     """Engine-facing model interface.
 
-    The engine owns slot bookkeeping; the adapter owns the math and the
-    per-bucket compile caches.  ``prefill``/``decode`` take and return the
+    The engine owns slot/block bookkeeping; the adapter owns the math and
+    the per-bucket compile caches.  ``prefill``/``decode`` (slot mode) and
+    ``prefill_chunk``/``decode_paged`` (paged mode) take and return the
     cache pytree so the engine can thread it through jit with donation.
+    An adapter without the paged trio (``init_paged_cache`` /
+    ``prefill_chunk`` / ``decode_paged``) serves in slot mode only.
     """
 
     vocab_size: int
@@ -92,17 +112,21 @@ class TransformerAdapter(ModelAdapter):
     Runs the Block math (ln1 → qkv → causal attention → proj residual →
     ln2 → fc1/gelu/fc2 residual; f32 layernorm islands, tied LM head) as
     pure functions over the param pytree, with an explicit per-layer KV
-    cache the flax module doesn't carry.  Serving math is forced to f32
-    (``HVD_SERVE_DTYPE`` may widen training bf16 checkpoints) — greedy
-    parity across batch compositions is the contract and f32 keeps the
-    argmax far from dtype noise.
+    cache the flax module doesn't carry — contiguous per-slot rows in slot
+    mode, a block pool addressed through gathered block tables in paged
+    mode.  Serving math is forced to f32 (``HVD_SERVE_DTYPE`` may widen
+    training bf16 checkpoints) — greedy parity across batch compositions
+    is the contract and f32 keeps the argmax far from dtype noise.
 
     Constraints (asserted): dense local attention only — a serving replica
     is data-parallel and holds the full model, so ``seq_parallel``/MoE
     configs are for the training mesh, not here.
     """
 
-    def __init__(self, cfg, params, max_len: Optional[int] = None):
+    kv_token_cost = 1  # cache positions consumed per token (MLP: 0)
+
+    def __init__(self, cfg, params, max_len: Optional[int] = None,
+                 block_tokens: Optional[int] = None):
         import jax.numpy as jnp
         if cfg.seq_parallel is not None or cfg.moe_experts:
             raise ValueError(
@@ -114,6 +138,9 @@ class TransformerAdapter(ModelAdapter):
         self.max_len = min(max_len or cfg.max_len, cfg.max_len)
         self.num_layers = cfg.num_layers
         self.head_dim = cfg.d_model // cfg.num_heads
+        self.block_tokens = int(
+            block_tokens if block_tokens is not None
+            else os.environ.get("HVD_SERVE_BLOCK_TOKENS", "16"))
         dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}[
             os.environ.get("HVD_SERVE_DTYPE", "f32")]
         params = _unstack_if_scanned(params, cfg.num_layers)
@@ -122,15 +149,35 @@ class TransformerAdapter(ModelAdapter):
             lambda a: jnp.asarray(a, dtype=dtype), params)
         self._dtype = dtype
         self._prefill_cache: Dict[Tuple[int, int], object] = {}
-        self._decode_fn = None
+        self._chunk_cache: Dict[Tuple[int, int, int], object] = {}
+        self._decode_fns: Dict[int, object] = {}
+        self._paged_decode_fns: Dict[Tuple[int, int], object] = {}
+        self._copy_block_fn = None
         self._max_batch = None
+        self._num_blocks = None
 
     # -- cache --------------------------------------------------------------
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_len // self.block_tokens)
 
     def init_cache(self, max_batch: int):
         import jax.numpy as jnp
         self._max_batch = max_batch
         shape = (self.num_layers, max_batch, self.max_len,
+                 self.cfg.num_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, self._dtype),
+                "v": jnp.zeros(shape, self._dtype)}
+
+    def init_paged_cache(self, num_blocks: int, max_batch: int):
+        """Block pool ``[L, num_blocks, block_tokens, H, Dh]``: one
+        physical layout shared by every sequence; logical placement lives
+        in the per-sequence block tables (serve/blocks.py)."""
+        import jax.numpy as jnp
+        self._num_blocks = num_blocks
+        self._max_batch = max_batch
+        shape = (self.num_layers, num_blocks, self.block_tokens,
                  self.cfg.num_heads, self.head_dim)
         return {"k": jnp.zeros(shape, self._dtype),
                 "v": jnp.zeros(shape, self._dtype)}
@@ -176,7 +223,7 @@ class TransformerAdapter(ModelAdapter):
         return jnp.einsum("...d,vd->...v", x.astype(self._dtype),
                           params["wte"]["embedding"]).astype(jnp.float32)
 
-    # -- prefill ------------------------------------------------------------
+    # -- prefill (slot mode) -------------------------------------------------
 
     def _build_prefill(self, n: int, p_len: int):
         import jax
@@ -249,7 +296,107 @@ class TransformerAdapter(ModelAdapter):
             jnp.asarray(slot_arr))
         return cache, np.asarray(nxt)[:len(prompts)]
 
-    # -- decode -------------------------------------------------------------
+    # -- chunked prefill (paged mode) ----------------------------------------
+
+    def _build_prefill_chunk(self, n: int, c: int, NB: int):
+        import jax
+        import jax.numpy as jnp
+        scale = 1.0 / math.sqrt(self.head_dim)
+        L, BT = self.num_layers, self.block_tokens
+        MB = self.max_blocks_per_seq
+        S = MB * BT
+        H, Dh = self.cfg.num_heads, self.head_dim
+
+        def fn(params, cache, tokens, starts, lengths, tables):
+            # tokens [n, c] int32 (one prompt chunk per row); starts [n]
+            # (absolute position of tokens[i, 0]); lengths [n] (real chunk
+            # length <= c); tables [n, MB] (entry NB = hole: scatter drops
+            # the write, gather clamps and the validity mask zeroes it).
+            pos = starts[:, None] + jnp.arange(c)[None, :]        # [n, c]
+            in_chunk = jnp.arange(c)[None, :] < lengths[:, None]  # [n, c]
+            x = params["wte"]["embedding"][tokens] \
+                + params["wpe"]["embedding"][
+                    jnp.minimum(pos, self.max_len - 1)]
+            ck, cv = cache["k"], cache["v"]
+            wblk = jnp.take_along_axis(
+                tables, jnp.minimum(pos // BT, MB - 1), axis=1)
+            wblk = jnp.where(in_chunk, wblk, NB)  # pad tail: drop writes
+            woff = pos % BT
+            # Query at absolute position p attends to cache positions
+            # <= p — the chunk's own K/V are scattered into the pool
+            # BEFORE the gather, so intra-chunk causal attention falls
+            # out of the same gather+mask as attention over earlier
+            # chunks / cached prefix blocks.
+            valid = (jnp.arange(S)[None, None, None, :]
+                     <= pos[:, None, :, None])    # [n, 1, c, S]
+            for l in range(L):
+                blk = params[f"block_{l}"]
+                q, k, v = self._qkv(x, blk)       # [n, c, H, Dh]
+                ck = ck.at[l, wblk, woff].set(k)
+                cv = cv.at[l, wblk, woff].set(v)
+                # Gather-based paged attention: one jnp.take over the
+                # block table per layer reassembles each row's logical
+                # context [S, H, Dh] from arbitrary physical blocks.
+                # mode="clip": hole entries (the OOB sentinel NB) clamp to
+                # a real block whose garbage the validity mask zeroes —
+                # the default "fill" mode would inject NaN instead.
+                kk = jnp.take(ck[l], tables, axis=0, mode="clip") \
+                    .reshape(tables.shape[0], S, H, Dh)
+                vv = jnp.take(cv[l], tables, axis=0, mode="clip") \
+                    .reshape(tables.shape[0], S, H, Dh)
+                s = jnp.einsum("nqhe,nkhe->nhqk",
+                               q.astype(jnp.float32),
+                               kk.astype(jnp.float32)) * scale
+                s = jnp.where(valid, s, jnp.float32(-1e30))
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("nhqk,nkhe->nqhe", p,
+                                 vv.astype(jnp.float32)).astype(self._dtype)
+                x = self._ffn(self._proj(x, out, blk), blk)
+            last = jnp.take_along_axis(
+                x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            logits = self._logits(last, params)
+            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def prefill_chunk(self, cache, chunks, starts, tables):
+        """One iteration's prompt chunks: ``chunks[i]`` continues sequence
+        i's prompt at absolute position ``starts[i]`` with physical blocks
+        ``tables[i]``.  Returns ``(cache, next_tokens)``; the engine uses
+        ``next_tokens[i]`` only when the chunk completes its prompt (the
+        argmax at each chunk's last position)."""
+        import jax.numpy as jnp
+        n_bucket = _next_pow2(len(chunks))
+        max_c = max(len(ch) for ch in chunks)
+        c_bucket = prompt_bucket(max_c, cap=self.max_len)
+        # Pool geometry comes from the CACHE ARGUMENT, never from a
+        # mutable adapter attribute, and is part of the compile key: the
+        # traced program bakes the OOB hole sentinel (= num_blocks) into
+        # its closure, and an adapter is shareable across engines with
+        # different pool sizes (even interleaved) — a stale sentinel
+        # would silently scatter pad-tail K/V into a REAL block.
+        NB = int(cache["k"].shape[1])
+        key = (n_bucket, c_bucket, NB)
+        if key not in self._chunk_cache:
+            self._chunk_cache[key] = self._build_prefill_chunk(
+                n_bucket, c_bucket, NB)
+        MB = self.max_blocks_per_seq
+        tok = np.zeros((n_bucket, c_bucket), np.int32)
+        st = np.zeros((n_bucket,), np.int32)
+        ln = np.zeros((n_bucket,), np.int32)
+        tab = np.full((n_bucket, MB), NB, np.int32)
+        for i, (ch, s0, t) in enumerate(zip(chunks, starts, tables)):
+            tok[i, :len(ch)] = ch
+            st[i] = s0
+            ln[i] = len(ch)
+            tab[i, :len(t)] = t
+        cache, nxt = self._chunk_cache[key](
+            self.params, cache, jnp.asarray(tok), jnp.asarray(st),
+            jnp.asarray(ln), jnp.asarray(tab))
+        return cache, np.asarray(nxt)[:len(chunks)]
+
+    # -- decode (slot mode) --------------------------------------------------
 
     def _build_decode(self):
         import jax
@@ -292,12 +439,88 @@ class TransformerAdapter(ModelAdapter):
 
     def decode(self, cache, tokens, positions):
         import jax.numpy as jnp
-        if self._decode_fn is None:
-            self._decode_fn = self._build_decode()
-        cache, nxt = self._decode_fn(
+        if self._decode_fns.get(self._max_batch) is None:
+            self._decode_fns[self._max_batch] = self._build_decode()
+        cache, nxt = self._decode_fns[self._max_batch](
             self.params, cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32))
         return cache, np.asarray(nxt)
+
+    # -- decode (paged mode) -------------------------------------------------
+
+    def _build_paged_decode(self, B: int):
+        import jax
+        import jax.numpy as jnp
+        scale = 1.0 / math.sqrt(self.head_dim)
+        L = self.num_layers
+        BT, MB = self.block_tokens, self.max_blocks_per_seq
+        S = MB * BT
+        H, Dh = self.cfg.num_heads, self.head_dim
+
+        def fn(params, cache, tokens, positions, tables):
+            # tokens [B]; positions [B] (cache index this token's K/V
+            # lands at); tables [B, MB] block tables (entry NB for holes
+            # and inactive rows — scatter drops, gather clamps + mask).
+            pos = jnp.minimum(positions, self.max_len - 1)
+            x = params["wte"]["embedding"][tokens] \
+                + params["wpe"]["embedding"][pos]  # [B, d]
+            ck, cv = cache["k"], cache["v"]
+            wblk = jnp.take_along_axis(
+                tables, jnp.minimum(pos // BT, MB - 1)[:, None],
+                axis=1)[:, 0]                             # [B]
+            woff = pos % BT
+            s_idx = jnp.arange(S)[None, None, :]          # [1, 1, S]
+            valid = s_idx <= pos[:, None, None]           # [B, 1, S]
+            for l in range(L):
+                blk = params[f"block_{l}"]
+                q, k, v = self._qkv(x, blk)               # [B, H, Dh]
+                ck = ck.at[l, wblk, woff].set(k)
+                cv = cv.at[l, wblk, woff].set(v)
+                kk = jnp.take(ck[l], tables, axis=0,
+                              mode="clip").reshape(B, S, H, Dh)
+                vv = jnp.take(cv[l], tables, axis=0,
+                              mode="clip").reshape(B, S, H, Dh)
+                s = jnp.einsum("bhe,bshe->bhs",
+                               q.astype(jnp.float32),
+                               kk.astype(jnp.float32)) * scale
+                s = jnp.where(valid, s, jnp.float32(-1e30))
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhs,bshe->bhe", p,
+                                 vv.astype(jnp.float32)).astype(self._dtype)
+                x = self._ffn(self._proj(x, out, blk), blk)
+            logits = self._logits(x, params)
+            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def decode_paged(self, cache, tokens, positions, tables):
+        import jax.numpy as jnp
+        # Geometry from the call's own arguments + compile key, for the
+        # same shared-adapter reason as prefill_chunk (the program
+        # closes over the batch width; num_blocks shapes the cache).
+        key = (int(cache["k"].shape[1]), len(tokens))
+        if self._paged_decode_fns.get(key) is None:
+            self._paged_decode_fns[key] = self._build_paged_decode(
+                len(tokens))
+        cache, nxt = self._paged_decode_fns[key](
+            self.params, cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(tables, jnp.int32))
+        return cache, np.asarray(nxt)
+
+    def copy_block(self, cache, src: int, dst: int):
+        """Copy-on-write data move: duplicate one physical block across
+        all layers (the BlockManager already moved the reference).
+        Jitted with the cache DONATED so XLA updates the pool in place —
+        an eager ``.at[].set`` would materialize a second full pool to
+        move one block."""
+        import jax
+        import jax.numpy as jnp
+        if self._copy_block_fn is None:
+            def fn(c, s, d):
+                return {k: a.at[:, d].set(a[:, s]) for k, a in c.items()}
+            self._copy_block_fn = jax.jit(fn, donate_argnums=(0,))
+        return self._copy_block_fn(cache, jnp.int32(src), jnp.int32(dst))
 
 
 def _unstack_if_scanned(params, num_layers: int):
@@ -315,7 +538,12 @@ class MLPAdapter(ModelAdapter):
     """Cache-free stand-in model for engine-mechanics tests: the next
     token is ``argmax(MLP(one_hot(token)))`` — a deterministic Markov
     chain over the vocab, so batching/requeue/parity logic is exercised
-    without transformer compile cost."""
+    without transformer compile cost.  Serves in both modes: its paged
+    interface consumes zero blocks (``kv_token_cost = 0``)."""
+
+    kv_token_cost = 0
+    block_tokens = 1
+    max_blocks_per_seq = 0
 
     def __init__(self, mlp, params, vocab_size: int, max_len: int = 1024):
         import jax
@@ -329,12 +557,24 @@ class MLPAdapter(ModelAdapter):
     def init_cache(self, max_batch: int):
         return ()
 
+    def init_paged_cache(self, num_blocks: int, max_batch: int):
+        return ()
+
     def prefill(self, cache, prompts, slots):
         last = np.asarray([p[-1] for p in prompts], np.int32)
         return cache, np.asarray(self._apply(last))
 
+    def prefill_chunk(self, cache, chunks, starts, tables):
+        # Next token depends only on the chunk's last token; non-final
+        # chunks' outputs are ignored by the engine.
+        last = np.asarray([ch[-1] for ch in chunks], np.int32)
+        return cache, np.asarray(self._apply(last))
+
     def decode(self, cache, tokens, positions):
         return cache, np.asarray(self._apply(np.asarray(tokens, np.int32)))
+
+    def decode_paged(self, cache, tokens, positions, tables):
+        return self.decode(cache, tokens, positions)
 
 
 # ---------------------------------------------------------------------------
@@ -342,6 +582,7 @@ class MLPAdapter(ModelAdapter):
 # ---------------------------------------------------------------------------
 
 class _Slot:
+    """Slot-mode sequence state (contiguous per-slot cache rows)."""
     __slots__ = ("request", "length")
 
     def __init__(self, request: Request, length: int):
@@ -349,11 +590,32 @@ class _Slot:
         self.length = length  # prompt + generated so far (cache positions)
 
 
+class _Seq:
+    """Paged-mode sequence state."""
+    __slots__ = ("request", "length", "prompt_pos", "table", "hashes",
+                 "admit_seq", "published")
+
+    def __init__(self, request: Request, cached_tokens: int,
+                 table: List[int], hashes: List[int], admit_seq: int):
+        self.request = request
+        self.length = cached_tokens      # tokens with K/V in the pool
+        self.prompt_pos = cached_tokens  # prompt tokens consumed so far
+        self.table = table               # physical block ids, logical order
+        self.hashes = hashes             # prompt full-block chain hashes
+        self.admit_seq = admit_seq       # admission order (preempt youngest)
+        self.published = 0               # prefix-registered block watermark
+
+    @property
+    def decoding(self) -> bool:
+        return self.prompt_pos >= len(self.request.prompt)
+
+
 class InferenceEngine:
     """One continuous-batching decode loop (one per serving replica).
 
-    Owns: the model adapter, the slot table, the KV cache, and a worker
-    thread running admit → prefill → decode forever.  Completion is
+    Owns: the model adapter, the slot table, the KV storage (block pool +
+    BlockManager in paged mode, contiguous cache in slot mode), and a
+    worker thread running admit → prefill → decode forever.  Completion is
     per-request (batcher.Request events); the loop never blocks while any
     sequence is active.
     """
@@ -362,7 +624,11 @@ class InferenceEngine:
                  batcher: Optional[DynamicBatcher] = None,
                  metrics: Optional[ServeMetrics] = None,
                  max_batch: Optional[int] = None,
-                 replica_id: str = "replica-0"):
+                 replica_id: str = "replica-0",
+                 kv_mode: Optional[str] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         self.adapter = adapter
         self.max_batch = max_batch if max_batch is not None else int(
             os.environ.get("HVD_SERVE_MAX_BATCH", "8"))
@@ -374,11 +640,51 @@ class InferenceEngine:
             self.batcher._on_shed = \
                 lambda req, why: self.metrics.count_request(why)
         self.replica_id = replica_id
-        self._cache = adapter.init_cache(self.max_batch)
-        self._slots: List[Optional[_Slot]] = [None] * self.max_batch
+        mode = (kv_mode or os.environ.get("HVD_SERVE_KV_MODE",
+                                          "auto")).lower()
+        paged_capable = all(
+            hasattr(adapter, m)
+            for m in ("init_paged_cache", "prefill_chunk", "decode_paged"))
+        if mode == "auto":
+            mode = "paged" if paged_capable else "slot"
+        if mode not in ("paged", "slot"):
+            raise ValueError(f"kv_mode must be paged|slot|auto, got {mode}")
+        if mode == "paged" and not paged_capable:
+            raise ValueError(
+                f"{type(adapter).__name__} has no paged interface "
+                f"(prefill_chunk/decode_paged); use kv_mode='slot'")
+        self.kv_mode = mode
+        self.blocks: Optional[BlockManager] = None
+        if mode == "paged":
+            self._mb = int(getattr(adapter, "max_blocks_per_seq", 0))
+            bt = int(getattr(adapter, "block_tokens", 1))
+            nb = (num_blocks if num_blocks is not None
+                  else int(os.environ.get("HVD_SERVE_NUM_BLOCKS", "0")))
+            if nb <= 0:
+                # Default pool = the slot layout's HBM footprint
+                # (max_batch × max_len tokens): same budget, but shared,
+                # so mixed-length traffic admits far more sequences.
+                nb = self.max_batch * max(self._mb, 1)
+            pc = (prefix_cache if prefix_cache is not None
+                  else os.environ.get("HVD_SERVE_PREFIX_CACHE", "1")
+                  not in ("0", "false"))
+            self.blocks = BlockManager(nb, bt, prefix_cache=pc)
+            chunk = (prefill_chunk if prefill_chunk is not None
+                     else int(os.environ.get("HVD_SERVE_PREFILL_CHUNK",
+                                             "64")))
+            # <= 0 disables chunking: whole prompts prefill in one
+            # iteration (the unchunked bench/interference baseline).
+            self._chunk_budget = chunk if chunk > 0 else None
+            self._cache = adapter.init_paged_cache(nb, self.max_batch)
+        else:
+            self._mb = 0
+            self._cache = adapter.init_cache(self.max_batch)
+        self._slots: List[Optional[object]] = [None] * self.max_batch
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._admit_counter = 0
+        self._step_anchor: Optional[float] = None
         self.steps = 0
 
     # -- introspection -------------------------------------------------------
@@ -391,6 +697,11 @@ class InferenceEngine:
     def load(self) -> int:
         """Routing load: in-flight sequences + queued requests."""
         return self.active_count + self.batcher.depth()
+
+    def kv_stats(self) -> Optional[dict]:
+        """Block-pool utilization / prefix-cache statistics (None in slot
+        mode) — sampled by metrics render and replica healthz."""
+        return self.blocks.stats() if self.blocks is not None else None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -412,24 +723,63 @@ class InferenceEngine:
     def drain(self) -> List[Request]:
         """Stop the loop and return all in-flight requests WITHOUT
         completing them (dead-replica path: the scheduler resubmits them
-        elsewhere; generated-so-far tokens are discarded — greedy decoding
-        reproduces them exactly on the new replica)."""
+        elsewhere).  No cache state travels: generated-so-far tokens are
+        discarded and paged block references are released here — greedy
+        decoding reproduces the output exactly on the new replica, whose
+        own prefix cache (if any) re-fills from the prompt."""
         self.stop()
         with self._lock:
             inflight = []
             for i, s in enumerate(self._slots):
                 if s is not None:
+                    if self.blocks is not None:
+                        self.blocks.free_table(s.table)
                     s.request.generated = []
                     s.request.requeues += 1
                     inflight.append(s.request)
                     self._slots[i] = None
             return inflight
 
-    # -- the loop ------------------------------------------------------------
+    # -- shared helpers ------------------------------------------------------
 
     def _free_slots(self) -> List[int]:
         with self._lock:
             return [i for i, s in enumerate(self._slots) if s is None]
+
+    @staticmethod
+    def _finished(r: Request, token: int) -> bool:
+        return (len(r.generated) >= r.max_new_tokens
+                or (r.eos_id is not None and token == r.eos_id))
+
+    def _complete(self, r: Request) -> None:
+        r.complete()
+        self.metrics.count_request("ok")
+
+    def _fail_doomed(self, r: Request) -> bool:
+        """Requests that can never run on this engine fail loudly at
+        admission.  Returns True when the request was failed."""
+        total = len(r.prompt) + r.max_new_tokens
+        if total > self.adapter.max_len:
+            r.fail(ValueError(
+                f"{r.request_id}: prompt+max_new_tokens {total} exceeds "
+                f"max_len {self.adapter.max_len}"))
+            self.metrics.count_request("error")
+            return True
+        # Same cost formula as admission's cost/hard_cap (incl.
+        # kv_token_cost) — a mismatch would let _take's hard_cap bypass
+        # pop a request this check then declines to fail: an infinite
+        # requeue livelock.
+        if self.blocks is not None and self._mb and \
+                self._blocks_for_tokens(total) > self.blocks.capacity:
+            r.fail(ValueError(
+                f"{r.request_id}: needs "
+                f"{self._blocks_for_tokens(total)} KV blocks but the "
+                f"pool holds {self.blocks.capacity}"))
+            self.metrics.count_request("error")
+            return True
+        return False
+
+    # -- slot-mode loop ------------------------------------------------------
 
     def _admit(self, block_s: float) -> int:
         free = self._free_slots()
@@ -443,16 +793,7 @@ class InferenceEngine:
                 bucket_requests(admitted, cap=self.adapter.max_len).items()):
             # One prefill per shape bucket (batcher module doc); requests
             # whose prompt would overflow the cache fail loudly here.
-            runnable, doomed = [], []
-            for r in group:
-                (runnable if len(r.prompt) + r.max_new_tokens
-                 <= self.adapter.max_len else doomed).append(r)
-            for r in doomed:
-                r.fail(ValueError(
-                    f"{r.request_id}: prompt+max_new_tokens "
-                    f"{len(r.prompt) + r.max_new_tokens} exceeds max_len "
-                    f"{self.adapter.max_len}"))
-                self.metrics.count_request("error")
+            runnable = [r for r in group if not self._fail_doomed(r)]
             if not runnable:
                 continue
             slots = free[cursor:cursor + len(runnable)]
@@ -478,21 +819,13 @@ class InferenceEngine:
                 len(runnable), p_bucket, (now - t0) * 1e3)
         return cursor
 
-    @staticmethod
-    def _finished(r: Request, token: int) -> bool:
-        return (len(r.generated) >= r.max_new_tokens
-                or (r.eos_id is not None and token == r.eos_id))
-
-    def _complete(self, r: Request) -> None:
-        r.complete()
-        self.metrics.count_request("ok")
-
-    def _decode_once(self) -> None:
+    def _decode_once(self) -> int:
         with self._lock:
             active = [(i, s) for i, s in enumerate(self._slots)
                       if s is not None]
         if not active:
-            return
+            self._step_anchor = None
+            return 0
         tokens = np.zeros((self.max_batch,), np.int32)
         positions = np.zeros((self.max_batch,), np.int32)
         for i, s in active:
@@ -501,7 +834,14 @@ class InferenceEngine:
         t0 = time.monotonic()
         self._cache, nxt = self.adapter.decode(self._cache, tokens,
                                                positions)
-        dt_ms = (time.monotonic() - t0) * 1e3
+        now = time.monotonic()
+        # token_step is the INTER-decode-step latency while the engine
+        # stays busy: everything between two decode completions (prefill,
+        # admission) counts, so a prefill stalling decodes shows up in the
+        # p99 — the statistic chunked prefill is built to hold flat.
+        dt_ms = (now - (self._step_anchor if self._step_anchor is not None
+                        else t0)) * 1e3
+        self._step_anchor = now
         with self._lock:
             for i, s in active:
                 if self._slots[i] is not s:
@@ -516,32 +856,321 @@ class InferenceEngine:
         self.steps += 1
         self.metrics.observe_decode_step(dt_ms, len(active), len(active))
         self.metrics.maybe_emit_timeline()
+        return len(active)
+
+    # -- paged-mode loop -----------------------------------------------------
+
+    def _blocks_for_tokens(self, tokens: int) -> int:
+        if not self._mb:
+            return 0
+        return self.blocks.blocks_for(
+            tokens * getattr(self.adapter, "kv_token_cost", 1))
+
+    def _admit_paged(self, block_s: float) -> int:
+        free = self._free_slots()
+        if not free:
+            return 0
+        use_blocks = self.blocks is not None and self._mb > 0
+        # A sequence's whole lifetime fits prompt + max_new_tokens cache
+        # positions, so admission reserves exactly that (the paged win
+        # over slot mode is not reserving max_len) — no decode-time
+        # growth can exhaust the pool, so preemption stays a defensive
+        # path instead of a steady-state tax.
+        admitted = self.batcher.get_admission(
+            len(free), block_s=block_s,
+            budget=self.blocks.available() if use_blocks else None,
+            cost=(lambda r: self._blocks_for_tokens(
+                len(r.prompt) + r.max_new_tokens)) if use_blocks else None,
+            hard_cap=self.blocks.capacity if use_blocks else None)
+        if not admitted:
+            return 0
+        cursor = 0
+        for idx, r in enumerate(admitted):
+            if self._fail_doomed(r):
+                continue
+            cached_ids: List[int] = []
+            cached_tokens = 0
+            hashes: List[int] = []
+            if use_blocks:
+                if self.blocks.prefix_cache_enabled:
+                    # Hash once; lookup reuses them (hashing is
+                    # O(prompt) Python work on the decode-critical
+                    # engine thread).
+                    hashes = chain_hashes(r.prompt,
+                                          self.blocks.block_tokens)
+                    cached_ids, cached_tokens = \
+                        self.blocks.lookup_prefix(r.prompt, hashes=hashes)
+                need = self._blocks_for_tokens(
+                    len(r.prompt) + r.max_new_tokens) - len(cached_ids)
+                try:
+                    fresh = self.blocks.allocate(need) if need > 0 else []
+                except NoFreeBlocksError:
+                    # The admission budget counted retained blocks an
+                    # earlier request in THIS batch just claimed.  Put
+                    # this and every later admitted request back in order
+                    # and stop admitting this round.
+                    self.blocks.free_table(cached_ids)
+                    self.batcher.requeue_front(admitted[idx:])
+                    break
+            else:
+                fresh = []
+            seq = _Seq(r, cached_tokens, cached_ids + fresh, hashes,
+                       self._admit_counter)
+            self._admit_counter += 1
+            r.replica_id = self.replica_id
+            slot = free[cursor]
+            cursor += 1
+            with self._lock:
+                self._slots[slot] = seq
+        return cursor
+
+    def _prefill_step(self) -> int:
+        """Advance prompt prefills by at most ``HVD_SERVE_PREFILL_CHUNK``
+        tokens total (Sarathi-style per-iteration budget), oldest sequence
+        first, in ONE batched chunk-prefill call.  Returns prompt tokens
+        processed."""
+        with self._lock:
+            pending = [(i, s) for i, s in enumerate(self._slots)
+                       if s is not None and not s.decoding]
+        if not pending:
+            return 0
+        pending.sort(key=lambda t: t[1].admit_seq)
+        budget = self._chunk_budget if self._chunk_budget is not None \
+            else float("inf")
+        sel: List[Tuple[int, _Seq, int]] = []
+        for i, s in pending:
+            if budget <= 0:
+                break
+            take = int(min(len(s.request.prompt) - s.prompt_pos, budget))
+            sel.append((i, s, take))
+            budget -= take
+        chunks = [s.request.prompt[s.prompt_pos:s.prompt_pos + take]
+                  for _, s, take in sel]
+        starts = [s.prompt_pos for _, s, _ in sel]
+        tables = [list(s.table) for _, s, _ in sel]
+        self._cache, first = self.adapter.prefill_chunk(
+            self._cache, chunks, starts, tables)
+        now = time.monotonic()
+        total = 0
+        bt = self.blocks.block_tokens if self.blocks is not None else 1
+        with self._lock:
+            for (i, s, take), tok in zip(sel, first):
+                if self._slots[i] is not s:
+                    continue  # drained concurrently
+                s.prompt_pos += take
+                s.length += take
+                total += take
+                if self._mb and s.hashes:
+                    # Publish blocks COMPLETED BY THIS CHUNK for prefix
+                    # reuse (watermarked — re-walking from 0 would be
+                    # quadratic in prompt length; cached-hit blocks are
+                    # already registered and skip via the no-op path).
+                    # s.hashes is empty when prefix caching is off.
+                    for b in range(s.published, s.prompt_pos // bt):
+                        self.blocks.register(s.hashes[b], s.table[b])
+                    s.published = max(s.published, s.prompt_pos // bt)
+                if not s.decoding:
+                    continue
+                tok = int(tok)
+                r = s.request
+                r.first_token_at = now
+                r.generated.append(tok)
+                self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
+                if self._finished(r, tok):
+                    self._complete(r)
+                    if self.blocks is not None:
+                        self.blocks.free_table(s.table)
+                    self._slots[i] = None
+        return total
+
+    def _preempt(self, slot: int, s: "_Seq") -> None:
+        """Victim path for pool exhaustion: release the sequence's blocks
+        and requeue its request at the FRONT of this engine's own queue —
+        it restarts from the prompt later (greedy decoding reproduces the
+        answer exactly; its prompt blocks likely still sit in the prefix
+        cache)."""
+        with self._lock:
+            if self._slots[slot] is s:
+                self._slots[slot] = None
+        self.blocks.free_table(s.table)
+        s.request.generated = []
+        s.request.requeues += 1
+        self.metrics.count_request("preempted")
+        self.batcher.requeue_front([s.request])
+        get_logger().warning(
+            "%s: preempted %s (KV pool exhausted); requeued",
+            self.replica_id, s.request.request_id)
+
+    def _ensure_write_blocks(self, active):
+        """Guarantee each decoding sequence owns a writable block for
+        cache position ``length`` (growing its table, CoW-forking shared
+        blocks); preempts youngest-first on pool exhaustion.  Returns the
+        sequences that still hold a slot."""
+        ok = []
+        for i, s in sorted(active, key=lambda t: t[1].admit_seq):
+            with self._lock:
+                if self._slots[i] is not s:
+                    continue  # preempted as an earlier sequence's victim
+            placed = False
+            while not placed:
+                # Both arms can exhaust the pool (a CoW fork allocates
+                # too) — either way the youngest sequence is preempted
+                # and the arm retried.
+                try:
+                    bidx = s.length // self.blocks.block_tokens
+                    if bidx < len(s.table):
+                        old = s.table[bidx]
+                        bid, copied = self.blocks.ensure_writable(old)
+                        if copied:
+                            # Release the old reference only AFTER the
+                            # device copy succeeds (ensure_writable's
+                            # contract): a failed copy must not leave
+                            # the table pointing at a freed block.
+                            try:
+                                self._cache = self.adapter.copy_block(
+                                    self._cache, old, bid)
+                            except BaseException:
+                                self.blocks.free(bid)  # never entered
+                                raise                  # a table
+                            s.table[bidx] = bid
+                            self.blocks.free(old)
+                        placed = True
+                        ok.append((i, s))
+                        continue
+                    s.table.extend(self.blocks.allocate(1))
+                except NoFreeBlocksError:
+                    with self._lock:
+                        live = [(j, t) for j, t in enumerate(self._slots)
+                                if t is not None]
+                    victim_slot, victim = max(
+                        live, key=lambda t: t[1].admit_seq)
+                    self._preempt(victim_slot, victim)
+                    if victim is s:
+                        placed = True  # s itself evicted; skip this step
+        return ok
+
+    def _decode_once_paged(self) -> int:
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None and s.decoding]
+        if not active:
+            self._step_anchor = None
+            return 0
+        if self._mb:
+            active = self._ensure_write_blocks(active)
+            if not active:
+                self._step_anchor = None
+                return 0
+        nb = self.blocks.capacity if self.blocks is not None else 0
+        tokens = np.zeros((self.max_batch,), np.int32)
+        positions = np.zeros((self.max_batch,), np.int32)
+        tables = np.full((self.max_batch, self._mb), nb, np.int32)
+        for i, s in active:
+            tokens[i] = s.request.generated[-1]
+            positions[i] = s.length  # next cache index = current length
+            tables[i, :len(s.table)] = s.table
+        t0 = time.monotonic()
+        self._cache, nxt = self.adapter.decode_paged(
+            self._cache, tokens, positions, tables)
+        now = time.monotonic()
+        # Inter-decode-step latency (see _decode_once): prefill chunks
+        # between two decode steps land in this statistic by design.
+        dt_ms = (now - (self._step_anchor if self._step_anchor is not None
+                        else t0)) * 1e3
+        self._step_anchor = now
+        with self._lock:
+            for i, s in active:
+                if self._slots[i] is not s:
+                    continue  # drained/preempted concurrently
+                tok = int(nxt[i])
+                s.request.generated.append(tok)
+                s.length += 1
+                if self._finished(s.request, tok) \
+                        or s.length >= self.adapter.max_len:
+                    self._complete(s.request)
+                    if self.blocks is not None:
+                        self.blocks.free_table(s.table)
+                    self._slots[i] = None
+        self.steps += 1
+        self.metrics.observe_decode_step(dt_ms, len(active), len(active))
+        if self.blocks is not None:
+            self.metrics.maybe_emit_timeline(kv_stats=self.blocks.stats())
+        else:
+            self.metrics.maybe_emit_timeline()
+        return len(active)
+
+    # -- the loop ------------------------------------------------------------
+
+    def _cache_deleted(self) -> bool:
+        """True when a failed jit call consumed its donated cache buffers
+        (runtime failure AFTER donation): the pytree still holds arrays,
+        but they are deleted and every later call would raise."""
+        import jax
+        for leaf in jax.tree_util.tree_leaves(self._cache):
+            is_deleted = getattr(leaf, "is_deleted", None)
+            if is_deleted is not None and is_deleted():
+                return True
+        return False
+
+    def _recover(self, e: BaseException) -> None:
+        """Poisoned-batch recovery: fail the in-flight requests NOW with
+        the real error and keep serving.  Paged mode frees ONLY the
+        failed iteration's block references — the pool arrays and the
+        prefix registry survive (shared/registered blocks were written by
+        previously-successful iterations; the failed sequences' private
+        blocks return to the free list).  Exception: if the failed call
+        had already consumed its DONATED cache buffers (XLA runtime
+        failure mid-step), the pool is rebuilt and the prefix registry
+        reset with it — retained hashes must never describe zeroed
+        blocks.  Slot mode re-inits the whole cache (its contents are
+        suspect and per-slot rows aren't individually reclaimable)."""
+        get_logger().exception(
+            "%s: engine step failed: %s", self.replica_id, e)
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    s.request.fail(e)
+                    self.metrics.count_request("error")
+                    if self.blocks is not None:
+                        self.blocks.free_table(s.table)
+                    self._slots[i] = None
+        if self.kv_mode == "slot":
+            self._cache = self.adapter.init_cache(self.max_batch)
+        elif self._cache_deleted():
+            get_logger().warning(
+                "%s: donated KV pool was consumed by the failed step; "
+                "rebuilding pool and prefix registry", self.replica_id)
+            self.blocks = BlockManager(
+                self.blocks.capacity, self.blocks.block_tokens,
+                prefix_cache=self.blocks.prefix_cache_enabled)
+            self._cache = self.adapter.init_paged_cache(
+                self.blocks.capacity, self.max_batch)
+        self._step_anchor = None
 
     def _run(self) -> None:
         idle_block_s = float(os.environ.get("HVD_SERVE_IDLE_POLL_S", "0.05"))
+        paged = self.kv_mode == "paged"
         while not self._stop.is_set():
             try:
                 busy = self.active_count > 0
                 # Iteration-level scheduling: admission happens BETWEEN
                 # decode steps — non-blocking while sequences are active,
                 # blocking (bounded) when idle.
-                self._admit(0.0 if busy else idle_block_s)
-                self._decode_once()
+                block = 0.0 if busy else idle_block_s
+                if paged:
+                    self._admit_paged(block)
+                    pre = self._prefill_step()
+                    dec = self._decode_once_paged()
+                    if pre or dec:
+                        self.metrics.observe_iteration(pre, dec)
+                else:
+                    self._admit(block)
+                    self._decode_once()
             except Exception as e:
                 # A dying loop thread would hang every in-flight request
-                # until its client timeout: fail them NOW with the real
-                # error, reset the cache (its contents are suspect), and
-                # keep serving — one poisoned batch must not take the
-                # replica down.
-                get_logger().exception(
-                    "%s: engine step failed: %s", self.replica_id, e)
-                with self._lock:
-                    for i, s in enumerate(self._slots):
-                        if s is not None:
-                            s.request.fail(e)
-                            self.metrics.count_request("error")
-                            self._slots[i] = None
-                self._cache = self.adapter.init_cache(self.max_batch)
+                # until its client timeout — recover instead: one
+                # poisoned batch must not take the replica down.
+                self._recover(e)
 
     # -- synchronous one-shot (bench / tests) --------------------------------
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 #: Histogram bucket upper bounds in milliseconds (Prometheus ``le`` label).
 #: Spans sub-ms MLP decodes through multi-second cold-compile prefills.
@@ -83,16 +83,25 @@ class ServeMetrics:
         self.tokens_total = 0
         self.decode_steps_total = 0
         self.prefills_total = 0
+        # Per-iteration prefill/decode token split (chunked prefill's
+        # fairness statistic): prompt tokens processed vs decode tokens
+        # produced, per engine iteration (serve/engine.py paged loop).
+        self.prefill_tokens_total = 0
+        self.decode_tokens_total = 0
+        self.iterations_total = 0
         # Request outcomes: ok / shed (queue full) / expired (deadline) /
-        # requeued (drained off a dead replica, re-routed) / error.
+        # requeued (drained off a dead replica, re-routed) / preempted
+        # (evicted for KV blocks, re-admitted locally) / error.
         self.requests: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
-                                         "requeued": 0, "error": 0}
+                                         "requeued": 0, "preempted": 0,
+                                         "error": 0}
         # Batch occupancy: sequences active per decode step.
         self.occupancy_last = 0
         self.occupancy_max = 0
         self.occupancy_sum = 0
         self.occupancy_samples = 0
         self._queue_depth_fns: Dict[str, object] = {}
+        self._kv_stats_fns: Dict[str, object] = {}
         self._timeline = None
         self._timeline_every = int(os.environ.get(
             "HVD_SERVE_TIMELINE_EVERY", "16"))
@@ -118,6 +127,15 @@ class ServeMetrics:
             self.occupancy_samples += 1
             self._steps_since_emit += 1
 
+    def observe_iteration(self, prefill_tokens: int,
+                          decode_tokens: int) -> None:
+        """One engine iteration's prefill-vs-decode token split (the
+        chunked-prefill fairness statistic, docs/serving.md)."""
+        with self._lock:
+            self.prefill_tokens_total += prefill_tokens
+            self.decode_tokens_total += decode_tokens
+            self.iterations_total += 1
+
     def count_request(self, outcome: str) -> None:
         with self._lock:
             self.requests[outcome] = self.requests.get(outcome, 0) + 1
@@ -127,6 +145,13 @@ class ServeMetrics:
         a counter, so it is read where it lives instead of mirrored."""
         with self._lock:
             self._queue_depth_fns[replica_id] = fn
+
+    def register_kv_stats(self, replica_id: str, fn) -> None:
+        """``fn`` returns the replica engine's BlockManager ``stats()``
+        dict (or None in slot mode); sampled at render time like queue
+        depth."""
+        with self._lock:
+            self._kv_stats_fns[replica_id] = fn
 
     # -- export -------------------------------------------------------------
 
@@ -145,12 +170,32 @@ class ServeMetrics:
                 out[rid] = -1
         return out
 
+    def _kv_stats(self) -> Dict[str, dict]:
+        # Same locking discipline as _queue_depths: the stats fns take
+        # the BlockManager's lock, never sample them under self._lock.
+        with self._lock:
+            fns = dict(self._kv_stats_fns)
+        out = {}
+        for rid, fn in fns.items():
+            try:
+                stats = fn()
+            except Exception:
+                stats = None
+            if stats is not None:
+                out[rid] = stats
+        return out
+
     def snapshot(self) -> dict:
         depths = self._queue_depths()
+        kv = self._kv_stats()
         with self._lock:
             elapsed = max(time.monotonic() - self.started_at, 1e-9)
             occ_mean = (self.occupancy_sum / self.occupancy_samples
                         if self.occupancy_samples else 0.0)
+            hit_tokens = sum(s.get("prefix_hit_tokens", 0)
+                             for s in kv.values())
+            lookup_tokens = sum(s.get("prefix_lookup_tokens", 0)
+                                for s in kv.values())
             return {
                 "tokens_total": self.tokens_total,
                 "tokens_per_sec": round(self.tokens_total / elapsed, 2),
@@ -163,11 +208,24 @@ class ServeMetrics:
                 "queue_depth": depths,
                 "ttft": self.ttft_ms.to_dict(),
                 "token_step": self.token_step_ms.to_dict(),
+                "token_split": {
+                    "prefill_tokens": self.prefill_tokens_total,
+                    "decode_tokens": self.decode_tokens_total,
+                    "iterations": self.iterations_total,
+                },
+                "kv_blocks": kv,
+                "prefix_cache": {
+                    "hit_tokens": hit_tokens,
+                    "lookup_tokens": lookup_tokens,
+                    "hit_rate": round(hit_tokens / lookup_tokens, 4)
+                    if lookup_tokens else 0.0,
+                },
             }
 
     def render(self) -> str:
         """Prometheus text exposition (version 0.0.4 format)."""
         depths = self._queue_depths()
+        kv = self._kv_stats()
         with self._lock:
             lines = []
 
@@ -208,6 +266,30 @@ class ServeMetrics:
             for rid, depth in sorted(depths.items()):
                 lines.append(
                     f'hvd_serve_queue_depth{{replica="{rid}"}} {depth}')
+            lines.append("# TYPE hvd_serve_prefill_tokens_total counter")
+            lines.append(
+                f"hvd_serve_prefill_tokens_total "
+                f"{self.prefill_tokens_total}")
+            lines.append("# TYPE hvd_serve_decode_tokens_total counter")
+            lines.append(
+                f"hvd_serve_decode_tokens_total {self.decode_tokens_total}")
+            # Paged-KV utilization + prefix cache (docs/serving.md).
+            lines.append("# TYPE hvd_serve_kv_blocks gauge")
+            for rid, s in sorted(kv.items()):
+                for state in ("used", "free", "retained"):
+                    lines.append(
+                        f'hvd_serve_kv_blocks{{replica="{rid}",'
+                        f'state="{state}"}} {s.get(state, 0)}')
+            lines.append("# TYPE hvd_serve_kv_cow_copies_total counter")
+            for rid, s in sorted(kv.items()):
+                lines.append(
+                    f'hvd_serve_kv_cow_copies_total{{replica="{rid}"}} '
+                    f'{s.get("cow", 0)}')
+            lines.append("# TYPE hvd_serve_prefix_cache_hit_rate gauge")
+            for rid, s in sorted(kv.items()):
+                lines.append(
+                    f'hvd_serve_prefix_cache_hit_rate{{replica="{rid}"}} '
+                    f'{s.get("prefix_hit_rate", 0.0):g}')
             elapsed = max(time.monotonic() - self.started_at, 1e-9)
             lines.append("# TYPE hvd_serve_tokens_per_sec gauge")
             lines.append(
@@ -223,7 +305,11 @@ class ServeMetrics:
             self._timeline = timeline
             self._steps_since_emit = 0
 
-    def maybe_emit_timeline(self, force: bool = False) -> None:
+    def maybe_emit_timeline(self, force: bool = False,
+                            kv_stats: Optional[dict] = None) -> None:
+        """Rate-limited SERVE/* counter emission.  ``kv_stats`` (a
+        BlockManager ``stats()`` dict, passed by the paged engine) adds
+        block-utilization / prefix-hit-rate / token-split counters."""
         with self._lock:
             tl = self._timeline
             if tl is None:
@@ -242,7 +328,15 @@ class ServeMetrics:
                 "queue_depth": depth,
                 "ttft_p50_ms": self.ttft_ms.quantile(0.5),
                 "token_step_p50_ms": self.token_step_ms.quantile(0.5),
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "decode_tokens_total": self.decode_tokens_total,
             }
+            if kv_stats is not None:
+                counters["kv_blocks_used"] = kv_stats.get("used", 0)
+                counters["kv_blocks_free"] = kv_stats.get("free", 0)
+                counters["kv_blocks_retained"] = kv_stats.get("retained", 0)
+                counters["prefix_hit_rate"] = round(
+                    kv_stats.get("prefix_hit_rate", 0.0), 4)
         try:
             tl.serve_counter("engine", counters)
         except Exception:

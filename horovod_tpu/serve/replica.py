@@ -60,10 +60,16 @@ class Replica:
         return self.engine.load()
 
     def to_dict(self) -> dict:
-        return {"id": self.replica_id, "state": self.state,
-                "ranks": self.ranks, "load": self.load(),
-                "active": self.engine.active_count,
-                "queued": self.engine.batcher.depth()}
+        out = {"id": self.replica_id, "state": self.state,
+               "ranks": self.ranks, "load": self.load(),
+               "active": self.engine.active_count,
+               "queued": self.engine.batcher.depth(),
+               "kv_mode": self.engine.kv_mode}
+        kv = self.engine.kv_stats()
+        if kv is not None:
+            out["kv_blocks"] = {k: kv[k] for k in
+                                ("total", "used", "free", "retained")}
+        return out
 
 
 class ReplicaScheduler:
@@ -81,6 +87,8 @@ class ReplicaScheduler:
         for r in self.replicas:
             self.metrics.register_queue_depth(
                 r.replica_id, r.engine.batcher.depth)
+            self.metrics.register_kv_stats(
+                r.replica_id, r.engine.kv_stats)
 
     # -- routing -------------------------------------------------------------
 
@@ -235,11 +243,15 @@ class ReplicaScheduler:
 def build_replicas(adapter_factory: Callable[[], ModelAdapter],
                    num_replicas: Optional[int] = None,
                    max_batch: Optional[int] = None,
-                   metrics: Optional[ServeMetrics] = None
-                   ) -> ReplicaScheduler:
+                   metrics: Optional[ServeMetrics] = None,
+                   **engine_kwargs) -> ReplicaScheduler:
     """Partition the initialized world into ``num_replicas`` process sets
     and stand up one engine per set (adapter_factory is called per replica
-    — each replica owns its model arrays and KV cache).
+    — each replica owns its model arrays and KV block pool).
+
+    ``engine_kwargs`` pass through to each ``InferenceEngine`` (kv_mode /
+    num_blocks / prefill_chunk / prefix_cache — the paged-cache knobs,
+    docs/serving.md); unset ones fall back to their ``HVD_SERVE_*`` envs.
 
     Requires ``hvd.init()``; with no runtime (pure local serving) pass
     ``num_replicas`` explicitly and the process-set mapping is skipped.
@@ -262,6 +274,6 @@ def build_replicas(adapter_factory: Callable[[], ModelAdapter],
         engine = InferenceEngine(adapter_factory(),
                                  batcher=DynamicBatcher(),
                                  metrics=metrics, max_batch=max_batch,
-                                 replica_id=rid)
+                                 replica_id=rid, **engine_kwargs)
         replicas.append(Replica(rid, ps, engine))
     return ReplicaScheduler(replicas, metrics=metrics)

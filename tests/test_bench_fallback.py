@@ -48,7 +48,10 @@ def _bench_env(tag, **overrides):
                 "BENCH_PROFILE", "BENCH_BERT_BATCH", "BENCH_BERT_ATTN",
                 "BENCH_BERT_MLMPOS", "BENCH_GPT2_BATCH",
                 "BENCH_SERVE_REQUESTS", "BENCH_SERVE_NEWTOKENS",
-                "BENCH_SERVE_REPLICAS"):
+                "BENCH_SERVE_REPLICAS", "BENCH_SERVE_SLOT_BATCH",
+                "HVD_SERVE_BLOCK_TOKENS", "HVD_SERVE_PREFILL_CHUNK",
+                "HVD_SERVE_PREFIX_CACHE", "HVD_SERVE_KV_MODE",
+                "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -143,11 +146,13 @@ def test_no_prior_capture_fails_with_clear_message():
 
 
 def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
-    """ISSUE 4 satellite: BENCH_MODEL=serve runs the continuous-batching
-    serving microbench (bench.bench_serve) end-to-end on CPU under
-    BENCH_SMOKE shapes and the emitted record carries the throughput AND
-    latency keys the serving story is judged on — tokens/sec, the
-    TTFT / per-output-token split, and achieved batch occupancy."""
+    """ISSUE 4 satellite + ISSUE 5 satellite: BENCH_MODEL=serve runs the
+    continuous-batching serving microbench (bench.bench_serve)
+    end-to-end on CPU under BENCH_SMOKE shapes and the emitted record
+    carries the throughput AND latency keys the serving story is judged
+    on — tokens/sec, the TTFT / per-output-token split, achieved batch
+    occupancy — plus the ISSUE 5 paged/chunked/prefix arm records with
+    their config keys and in-band exactness checks."""
     tag = "pytestservesmoke"
     path = os.path.join(_REPO, "artifacts",
                         f"last_bench_serve_smoke_{tag}.json")
@@ -171,6 +176,26 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         # Continuous batching demonstrably engaged even in the smoke run.
         assert last["occupancy_max"] > 1
         assert last["requests"]["ok"] >= 16
+        # ISSUE 5: the paged-cache config keys and the three arms.
+        assert last["kv_mode"] == "paged"
+        assert last["block_tokens"] == 16
+        assert last["prefill_chunk"] > 0
+        assert last["prefix_cache"] is True
+        paged = last["paged"]
+        for key in ("budget_tokens", "admitted_concurrent",
+                    "slot_admitted_concurrent", "admit_ratio",
+                    "tokens_per_sec", "slot_tokens_per_sec"):
+            assert key in paged, f"paged.{key} missing: {paged}"
+        assert paged["outputs_match"] is True  # batched==single==slot
+        chunked = last["chunked"]
+        for key in ("prefill_chunk", "token_step_p99_ms",
+                    "unchunked_token_step_p99_ms"):
+            assert key in chunked, f"chunked.{key} missing: {chunked}"
+        assert chunked["outputs_match"] is True
+        prefix = last["prefix"]
+        for key in ("enabled", "hit_rate", "hit_tokens", "cow_copies"):
+            assert key in prefix, f"prefix.{key} missing: {prefix}"
+        assert prefix["hit_rate"] > 0  # shared-prefix storm really hit
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -1,0 +1,168 @@
+"""ISSUE 5 satellite: fp16/bf16 compression on the fused flat-buffer
+eager path.
+
+Before this change, ``compression != none`` forced the eager gradient
+path off the single-flat-buffer dispatch onto the per-bucket grouped
+path (per-tensor compress/decompress + one grouped collective) —
+docs/tensor_fusion.md documented it as the open gap.  Now each
+same-dtype fusion bucket packs once, compresses ONCE, and dispatches ONE
+collective.  These tests pin:
+
+* parity — the fused-compressed result equals the per-tensor
+  compress → reduce → decompress reference exactly (casts are
+  elementwise, so compress(concat) == concat(compress));
+* dispatch count — one engine dispatch per same-dtype bucket, wire
+  payload in the compressed dtype;
+* routing — ``_allreduce_tree`` sends compressed multi-leaf eager trees
+  through ``_fused_allreduce`` on a multi-process topology (and keeps
+  the grouped path in emulated mode).
+
+The engine is faked (single-rank ``single``-path semantics: allreduce of
+one participant is the identity up to scale factors), so the data-path
+transform — pack → compress → dispatch → decompress → slice — is pinned
+hermetically without a multi-process world.
+"""
+
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import ops as _ops
+from horovod_tpu.compression import Compression
+
+
+class _FakeEngine:
+    """Records every dispatch; applies the caller's single-participant
+    reduction (exactly what EagerEngine.run does at np=1)."""
+
+    def __init__(self):
+        self.dispatches = []
+
+    def run(self, kind, body, tensors, sig, single, name=None, **kw):
+        self.dispatches.append({
+            "kind": kind, "name": name,
+            "dtypes": [str(t.dtype) for t in tensors],
+            "sizes": [int(t.size) for t in tensors],
+        })
+        return single(tensors)
+
+
+@pytest.fixture()
+def fake_engine(hvd8, monkeypatch):
+    eng = _FakeEngine()
+    monkeypatch.setattr(_ops, "_engine", lambda: eng)
+    return eng
+
+
+def _tensors():
+    rng = np.random.RandomState(0)
+    return [jnp.asarray(rng.randn(*s).astype(np.float32))
+            for s in ((4, 3), (7,), (2, 2, 2))]
+
+
+@pytest.mark.parametrize("comp,wire", [(Compression.fp16, "float16"),
+                                       (Compression.bf16, "bfloat16")])
+def test_fused_allreduce_compresses_bucket_once(fake_engine, comp, wire):
+    ts = _tensors()
+    outs = _ops._fused_allreduce(ts, op=hvd.Average, compression=comp,
+                                 prescale_factor=2.0)
+    # ONE dispatch for the whole bucket, wire payload in the compressed
+    # dtype, flat size = sum of the tensors.
+    assert len(fake_engine.dispatches) == 1
+    d = fake_engine.dispatches[0]
+    assert d["dtypes"] == [wire]
+    assert d["sizes"] == [sum(int(t.size) for t in ts)]
+    assert d["name"].startswith(f"fusedbuf.{wire}.")
+    # Parity vs the per-tensor grouped compress path: compress each
+    # tensor, apply the (identity-at-np=1) reduction + scale, decompress.
+    for t, out in zip(ts, outs):
+        wire_t, ctx = comp.compress(t)
+        ref = comp.decompress(wire_t.astype(jnp.float32) * 2.0, ctx)
+        assert out.dtype == t.dtype and out.shape == t.shape
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_fused_allreduce_none_compression_unchanged(fake_engine):
+    ts = _tensors()
+    outs = _ops._fused_allreduce(ts, op=hvd.Sum)
+    assert len(fake_engine.dispatches) == 1
+    assert fake_engine.dispatches[0]["dtypes"] == ["float32"]
+    for t, out in zip(ts, outs):
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(t))
+
+
+def test_allreduce_tree_routes_compressed_buckets_through_fused(
+        fake_engine, monkeypatch):
+    """With a true multi-process topology, a compressed multi-leaf eager
+    gradient tree must take the fused path: one dispatch per same-dtype
+    fusion bucket (not one per tensor), each on a compressed flat
+    buffer."""
+    from horovod_tpu import core as _core
+    from horovod_tpu import optimizer as opt_mod
+    monkeypatch.setattr(
+        _core._state, "topology",
+        types.SimpleNamespace(size=2, emulated=False))
+    rng = np.random.RandomState(1)
+    grads = {f"layer_{i}": jnp.asarray(rng.randn(8, 4).astype(np.float32))
+             for i in range(6)}
+    reduced = opt_mod._allreduce_tree(
+        grads, opt_mod.ReduceOp.SUM, Compression.fp16, 1.0, 1.0, None)
+    # All six small leaves fit one 128 MB bucket: exactly ONE dispatch,
+    # fp16 on the wire.
+    assert len(fake_engine.dispatches) == 1
+    assert fake_engine.dispatches[0]["dtypes"] == ["float16"]
+    for k, g in grads.items():
+        ref = g.astype(jnp.float16).astype(jnp.float32)  # wire round-trip
+        np.testing.assert_array_equal(np.asarray(reduced[k]),
+                                      np.asarray(ref))
+
+
+def test_custom_compressor_keeps_grouped_path(fake_engine, monkeypatch):
+    """A user-defined Compressor subclass is NOT elementwise-guaranteed
+    (compress(concat) != concat(compress) for e.g. per-tensor scaling),
+    so it must keep the per-tensor grouped dispatch even on a true
+    multi-process topology."""
+    from horovod_tpu import core as _core
+    from horovod_tpu import optimizer as opt_mod
+    from horovod_tpu.compression import Compressor
+
+    class _PerTensorScale(Compressor):
+        @staticmethod
+        def compress(tensor):
+            scale = jnp.max(jnp.abs(tensor)) + 1e-9
+            return tensor / scale, scale
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor * ctx
+
+    monkeypatch.setattr(
+        _core._state, "topology",
+        types.SimpleNamespace(size=2, emulated=False))
+    grads = [jnp.asarray([1.0, 2.0]), jnp.asarray([100.0, 200.0])]
+    out = opt_mod._allreduce_tree(
+        grads, opt_mod.ReduceOp.SUM, _PerTensorScale, 1.0, 1.0, None)
+    assert all(d["kind"] == "grouped_allreduce"
+               for d in fake_engine.dispatches)
+    for g, o in zip(grads, out):  # per-tensor scales round-trip exactly
+        np.testing.assert_allclose(np.asarray(o), np.asarray(g),
+                                   rtol=1e-6)
+
+
+def test_allreduce_tree_emulated_mode_keeps_grouped_path(fake_engine,
+                                                         monkeypatch):
+    """Emulated topologies must NOT take the flat pack (their tensors
+    are per-rank stacks): the grouped dispatch stays."""
+    from horovod_tpu import core as _core
+    from horovod_tpu import optimizer as opt_mod
+    monkeypatch.setattr(
+        _core._state, "topology",
+        types.SimpleNamespace(size=2, emulated=True))
+    grads = [jnp.ones((3,), jnp.float32), jnp.ones((5,), jnp.float32)]
+    opt_mod._allreduce_tree(
+        grads, opt_mod.ReduceOp.SUM, Compression.fp16, 1.0, 1.0, None)
+    assert all(d["kind"] == "grouped_allreduce"
+               for d in fake_engine.dispatches)

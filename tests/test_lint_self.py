@@ -44,8 +44,8 @@ def test_lint_covers_the_whole_tree():
     # land unlinted.
     serve_files = [f for f in files
                    if os.sep + os.path.join("serve", "") in f]
-    for mod in ("engine.py", "batcher.py", "replica.py", "server.py",
-                "metrics.py"):
+    for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
+                "server.py", "metrics.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     assert not any("__pycache__" in f for f in files)
