@@ -49,7 +49,9 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_BLOCK_TOKENS": "16",
                  "HVD_SERVE_PREFILL_CHUNK": "64",
                  "HVD_SERVE_PREFIX_CACHE": "1",
-                 "HVD_SERVE_KV_MODE": "auto"}
+                 "HVD_SERVE_KV_MODE": "auto",
+                 "HVD_FAULTLINE_SEED": "0",
+                 "HVD_FAULTLINE_PLAN": ""}
 
 
 def _last_good_path():
@@ -565,6 +567,83 @@ def bench_serve():
         "evictions": prefix_kv["evictions"],
     }
 
+    # -- arm 4: faults — recovery time + goodput under a seeded plan ----------
+    # The robustness trajectory (ISSUE 6): the identical storm runs under
+    # a seeded FaultPlan (faultline) — a poisoned engine step on
+    # replica-0 plus a rank kill + recovery (mark_dead → mark_alive, the
+    # scale-up path) on the last replica — and the record captures what
+    # the throughput arms cannot: how fast the fleet is BACK ("replica
+    # re-admitted and answering") and how much accepted work survived
+    # first-try ("goodput_ratio"; failed requests are retried client-side
+    # and still checked for correctness, so faults cost latency, never
+    # wrong answers).
+    from horovod_tpu import faultline as _fl
+    fault_seed = int(os.environ.get(
+        "HVD_FAULTLINE_SEED", KNOB_DEFAULTS["HVD_FAULTLINE_SEED"]))
+    it = iter(adapters)
+    fault_metrics = ServeMetrics()
+    fsched = build_replicas(lambda: next(it), num_replicas=replicas,
+                            metrics=fault_metrics)
+    fsched.start()
+    victim = fsched.replicas[-1]
+    plan = _fl.install(_fl.FaultPlan([
+        _fl.FaultSpec("slow-decode", target="replica-0", param=0.002),
+        _fl.FaultSpec("poison-step", target="replica-0"),
+    ], seed=fault_seed))
+    recovery_box = {}
+
+    def kill_and_recover():
+        deadline = time.monotonic() + 120
+        while victim.engine.load() == 0 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        fsched.mark_dead(victim.replica_id, reason="bench fault arm")
+        t_kill = time.perf_counter()
+        fsched.mark_alive(victim.replica_id, reason="bench rank recovery")
+        while fsched.healthz()["status"] != "ok" \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+        # Recovered means ANSWERING, not just listed: a probe submitted
+        # straight to the revived replica's queue must complete.
+        probe = Request(prompts[0], max_new_tokens=2)
+        victim.engine.batcher.submit(probe)
+        probe.result(timeout=600)
+        recovery_box["recovery_s"] = time.perf_counter() - t_kill
+
+    killer = threading.Thread(target=kill_and_recover, daemon=True)
+    killer.start()
+    first_try_fail = 0
+    fault_outs = []
+    fault_requests = [Request(p, max_new_tokens=new_tokens)
+                      for p in prompts]
+    for r in fault_requests:
+        fsched.submit(r)
+    for i, r in enumerate(fault_requests):
+        try:
+            fault_outs.append(r.result(timeout=600))
+        except Exception:
+            # Client-side retry: a poisoned step fails its batch with the
+            # real error (engine contract); the caller retries, as a real
+            # front-end would.  Counted against goodput.
+            first_try_fail += 1
+            retry = Request(prompts[i], max_new_tokens=new_tokens)
+            fsched.submit(retry)
+            fault_outs.append(retry.result(timeout=600))
+    killer.join(timeout=600)
+    _fl.uninstall()
+    fsched.stop()
+    fault_snap = fault_metrics.snapshot()
+    arm_faults = {
+        "seed": fault_seed,
+        "fired": plan.firing_sequence(),
+        "recovery_s": round(recovery_box.get("recovery_s", -1.0), 4),
+        "goodput_ratio": round(
+            (len(prompts) - first_try_fail) / max(len(prompts), 1), 4),
+        "requeued": fault_snap["requests"].get("requeued", 0),
+        "errors": fault_snap["requests"].get("error", 0),
+        "replica_events": fault_snap["replica_events"],
+        "outputs_match": fault_outs == outs,
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -591,6 +670,7 @@ def bench_serve():
         "paged": arm_paged,
         "chunked": arm_chunked,
         "prefix": arm_prefix,
+        "faults": arm_faults,
     })
 
 

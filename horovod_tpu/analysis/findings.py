@@ -89,6 +89,14 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "per rank and per retrace",
          "pass timestamps in as arguments, or measure outside the traced "
          "step"),
+    Rule("HVD009", ERROR,
+         "collective or KV-transport call inside a bare `except:` or an "
+         "`except Exception: pass` — the swallowed-fault antipattern: a "
+         "dropped control-plane error is invisible (a preemption watcher "
+         "that eats its scan error polls a ghost forever; a swallowed "
+         "collective desynchronizes ranks)",
+         "count the error into metrics, log it, back off and retry "
+         "(serve/replica.watch_preemption is the model), or re-raise"),
     # -- trace-level (jaxpr) rules -----------------------------------------
     Rule("HVD100", ERROR,
          "the step function failed to trace — the jaxpr checker reports the "

@@ -1,4 +1,4 @@
-"""AST rules HVD001-HVD008: distributed-training antipatterns.
+"""AST rules HVD001-HVD009: distributed-training antipatterns.
 
 The rules encode, as source-level patterns, the failure classes the
 reference framework only catches at runtime in the coordinator's
@@ -110,6 +110,15 @@ HOST_EFFECT_DOTTED_ROOTS = {"os", "subprocess", "io_callback"}
 
 SYNC_METHODS = {"block_until_ready"}
 SYNC_DOTTED = {"device_get"}
+
+# KV-transport verbs (runner/http_server.KVStoreClient and the native
+# server's API): control-plane calls whose failures must surface — a
+# silently-swallowed transport fault is how a preemption watcher dies
+# unnoticed (HVD009).  Generic method names (get/put/scan/delete) only
+# count when some earlier segment of the call chain looks like a KV
+# client ("kv" in the name, or a *client attribute/variable).
+KV_TRANSPORT_FNS = {"put", "get", "scan", "put_wait", "put_batch",
+                    "delete", "delete_scope", "scan_scope"}
 
 
 # -- small AST helpers ------------------------------------------------------
@@ -372,6 +381,7 @@ def analyze(tree: ast.Module, path: str) -> List[Finding]:
     _rule_swallowed_collective(mod, emit)          # HVD002
     _rule_traced_body_calls(mod, emit)             # HVD003/4/5/8 + HVD006
     _rule_closed_over_mutation(mod, emit)          # HVD007
+    _rule_swallowed_fault(mod, emit)               # HVD009
 
     # Dedup (nested rank-guards can flag one call twice) + stable order.
     seen, out = set(), []
@@ -544,6 +554,98 @@ def _rule_traced_body_calls(mod: _Module, emit) -> None:
         msg = _clock_call(dotted)
         if msg:
             emit("HVD008", node, msg)
+
+
+# -- HVD009: bare/silent except around collective or KV-transport calls ----
+
+def _is_kv_transport_call(call: ast.Call) -> Optional[str]:
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    if len(parts) < 2 or parts[-1] not in KV_TRANSPORT_FNS:
+        return None
+    base = [p.lower() for p in parts[:-1]]
+    if any("kv" in p or "client" in p for p in base):
+        return dotted
+    return None
+
+
+def _silent_handler(handler: ast.ExceptHandler) -> Optional[str]:
+    """Why this handler counts as fault-swallowing for HVD009, or None.
+
+    Two shapes (narrower than HVD002's any-non-raising handler):
+
+    * ``except:`` with no re-raise — catches EVERYTHING including
+      KeyboardInterrupt/SystemExit, whatever its body does;
+    * ``except Exception:`` (or BaseException) whose body is ONLY
+      ``pass``/``...``/``continue`` — the fault vanishes without a log
+      line, a metric, or a backoff.
+    """
+    def body_is_silent() -> bool:
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    stmt.value.value is Ellipsis:
+                continue
+            return False
+        return True
+
+    if handler.type is None:
+        if not any(isinstance(n, ast.Raise) for n in ast.walk(handler)):
+            return "bare 'except:'"
+        return None
+    names = _string_like_exc_names(handler.type)
+    if names & {"Exception", "BaseException"} and body_is_silent():
+        return f"'except {sorted(names)[0]}: pass'"
+    return None
+
+
+def _string_like_exc_names(node: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    nodes = node.elts if isinstance(node, ast.Tuple) else [node]
+    for n in nodes:
+        dotted = _dotted(n)
+        if dotted:
+            names.add(dotted.split(".")[-1])
+    return names
+
+
+def _rule_swallowed_fault(mod: _Module, emit) -> None:
+    """HVD009: a collective or KV-transport call inside a try whose
+    handler swallows faults SILENTLY (``_silent_handler``).  The
+    distributed consequence differs by call class — a swallowed
+    collective desynchronizes ranks (HVD002's concern, sharpened here to
+    the silent shapes), a swallowed KV-transport fault blinds the
+    control plane (a preemption watcher that eats its scan error polls a
+    ghost forever) — but the fix is the same: count the error into
+    metrics, log it, back off, and keep going, or re-raise."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        silent = next((why for why in map(_silent_handler, node.handlers)
+                       if why), None)
+        if silent is None:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _is_kv_transport_call(sub)
+                kind = "KV-transport"
+                if name is None:
+                    name = _is_collective_call(sub)
+                    kind = "collective"
+                if name is None:
+                    continue
+                emit("HVD009", sub,
+                     f"{kind} call '{name}' inside a try whose {silent} "
+                     f"swallows the fault silently; count it into "
+                     f"metrics, back off and retry, or re-raise — a "
+                     f"dropped fault here is invisible until the job "
+                     f"wedges")
 
 
 # -- HVD007: mutation of closed-over state in traced code -------------------

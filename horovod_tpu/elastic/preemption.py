@@ -73,6 +73,8 @@ class PreemptionSentinel:
         self._marked = False
         self._marker_refresh_at = 0.0
         self._startup_reconciled = False
+        from ..faultline import runtime as _flrt
+        _flrt.maybe_install_from_env()
 
     def _poll_once(self) -> Optional[str]:
         """Current maintenance event, or None when the endpoint is
@@ -90,6 +92,25 @@ class PreemptionSentinel:
     def step(self) -> None:
         """One poll + marker reconciliation (exposed for tests)."""
         event = self._poll_once()
+        from ..faultline import runtime as _flrt
+        plan = _flrt.PLAN
+        if plan is not None:
+            # ``preempt.poll`` injection point (marker publication): a
+            # kill-rank fault makes this poll behave exactly as if the
+            # metadata server announced maintenance — the marker goes out
+            # through the real publish/refresh/clear state machine, so a
+            # chaos run proves the whole notice→drain→clear→scale-up
+            # loop, not a shortcut around it.  ONLY for plans that
+            # exercise this point, an unreachable endpoint reads as
+            # "NONE" (the hermetic chaos world has no metadata server;
+            # without this substitution the cancelled event could never
+            # clear its marker) — a plan poking other layers must not
+            # convert a real metadata outage into a marker clear.
+            fired = plan.fire("preempt.poll", self.host)
+            if any(f.kind == "kill-rank" for f in fired):
+                event = "FAULTLINE_PREEMPT"
+            elif event is None and plan.targets_point("preempt.poll"):
+                event = "NONE"
         if event and event != "NONE":
             if not self._marked:
                 get_logger().warning(
@@ -131,8 +152,14 @@ class PreemptionSentinel:
                                       self.host)
                 self._marked = False
                 self._startup_reconciled = True
-            except Exception:
-                pass
+            except Exception as e:
+                # Transient KV error: retry next poll.  Logged (never
+                # silently dropped — hvdlint HVD009's swallowed-fault
+                # antipattern): a string of these means the host stays
+                # excluded, which an operator must be able to see.
+                get_logger().debug(
+                    "preemption marker clear failed on %s (retry next "
+                    "poll): %s", self.host, e)
         elif event is not None:
             self._startup_reconciled = True
 

@@ -225,8 +225,11 @@ class Negotiator:
                     # has moved on to this epoch (KV stays O(names x size)).
                     try:
                         self.client.delete(scope, f"resp/{name}/{epoch - 1}")
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # Best-effort GC — but never silent (HVD009): a
+                        # string of these means the KV store is growing.
+                        get_logger().debug(
+                            "verdict GC delete failed: %s", e)
                 # The coordinator feeds its own signature to the message
                 # table locally and learns the verdict as the return value
                 # — no request PUT, no verdict GET.
@@ -477,8 +480,9 @@ class Negotiator:
         if self.rank == last_rank:
             try:
                 self.client.delete(f"join@{self._gen}", "active")
-            except Exception:
-                pass
+            except Exception as e:
+                get_logger().debug(
+                    "join-round retire delete failed: %s", e)
 
     def _submit_and_wait(self, req_scope: str, sig: dict, name: str,
                          scope: str, resp_key: str) -> str:
@@ -615,8 +619,9 @@ class Negotiator:
             # epoch-scoped scope, never consumed again).
             try:
                 self.client.delete_scope(req_scope)
-            except Exception:
-                pass
+            except Exception as e:
+                get_logger().debug(
+                    "request-scope GC failed for %s: %s", req_scope, e)
 
     def _publish(self, name: str, epoch: int, err: str) -> str:
         """Publish the verdict for the waiting ranks; return it for the

@@ -391,8 +391,19 @@ class KVStoreClient:
     Keeps one persistent HTTP/1.1 connection per thread: the control plane
     issues a KV request per eager dispatch (ops/negotiation.py
     publish_dispatch), and per-request connection setup tripled its cost
-    (~1.5 ms → ~0.4 ms with keep-alive).  Stale/broken connections are
-    re-opened once per request."""
+    (~1.5 ms → ~0.4 ms with keep-alive).
+
+    Transport errors are RETRIED with capped jittered exponential backoff
+    (``HVD_KV_RETRY_MAX`` attempts total, delays ``HVD_KV_RETRY_BASE_MS``
+    · 2^n capped at ``HVD_KV_RETRY_CAP_MS``, each scaled by a uniform
+    [0.5, 1) jitter so a fleet retrying the same dead server doesn't
+    stampede in lockstep): connect failures, timeouts, and mid-response
+    disconnects are transient by nature — the KV server restarting or a
+    link flapping — and every verb here is idempotent (PUT/GET/DELETE/
+    scan; put_wait's re-put is its documented re-issue).  HTTP 4xx
+    responses are FATAL and never retried: the server answered, the
+    request itself is wrong, and retrying would just repeat the answer
+    (callers raise OSError on them immediately)."""
 
     def __init__(self, addr: str, port: int):
         self.addr = addr
@@ -400,6 +411,22 @@ class KVStoreClient:
         self.base = f"http://{addr}:{port}"
         import threading
         self._local = threading.local()
+        self.retry_max = max(int(os.environ.get("HVD_KV_RETRY_MAX", "3")),
+                             1)
+        self.retry_base_s = float(
+            os.environ.get("HVD_KV_RETRY_BASE_MS", "10")) / 1e3
+        self.retry_cap_s = float(
+            os.environ.get("HVD_KV_RETRY_CAP_MS", "2000")) / 1e3
+        from ..faultline import runtime as _flrt
+        _flrt.maybe_install_from_env()
+
+    def _retry_backoff_s(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (1-based): capped exponential
+        with jitter (class docstring)."""
+        import random
+        base = min(self.retry_base_s * (2 ** (attempt - 1)),
+                   self.retry_cap_s)
+        return base * (0.5 + random.random() / 2)
 
     def _conn(self, fresh: bool = False):
         sock = getattr(self._local, "sock", None)
@@ -436,29 +463,54 @@ class KVStoreClient:
         launcher's one core that overhead, times np, IS the control-plane
         latency floor (csrc/kv_server.cc header); this minimal writer/parser
         runs ~25 us against the same servers."""
+        import time as _time
+
+        from ..faultline import runtime as _flrt
         req = (f"{method} {path} HTTP/1.1\r\nHost: {self.addr}\r\n"
                f"Content-Length: {len(body) if body else 0}\r\n\r\n"
                .encode("ascii"))
         if body:
             req += body
-        for attempt in (0, 1):
-            sock = self._conn(fresh=attempt > 0)
+        for attempt in range(self.retry_max):
+            sock = None
             try:
+                if _flrt.PLAN is not None:
+                    # ``kv.request`` injection point (one consult per
+                    # ATTEMPT, so a drop train of length n exercises n
+                    # retries): delay-kv stalls the request, drop-kv-
+                    # response fails it as a transport error — landing in
+                    # the same retry path a real flake takes.
+                    for f in _flrt.fire("kv.request",
+                                        f"{self.addr}:{self.port}"):
+                        if f.kind == "delay-kv":
+                            _time.sleep(f.param or 0.02)
+                        elif f.kind == "drop-kv-response":
+                            raise ConnectionError(
+                                "faultline: dropped KV response")
+                sock = self._conn(fresh=attempt > 0)
                 sock.sendall(req)
                 return self._read_response(sock)
-            except (ConnectionError, OSError):
-                if attempt:
-                    # Drop the desynced socket: a request went out, so a
-                    # LATE response may still arrive — a later request
-                    # reusing this socket would consume it as its own
-                    # (http.client raised CannotSendRequest here; the
-                    # raw-socket path must poison the connection itself).
-                    try:
-                        sock.close()
-                    except Exception:
-                        pass
+            except (ConnectionError, OSError) as e:
+                if attempt + 1 >= self.retry_max:
+                    # Out of budget.  Drop the desynced socket: a request
+                    # went out, so a LATE response may still arrive — a
+                    # later request reusing this socket would consume it
+                    # as its own (http.client raised CannotSendRequest
+                    # here; the raw-socket path must poison the
+                    # connection itself).
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except Exception:
+                            pass
                     self._local.sock = None
                     raise
+                delay = self._retry_backoff_s(attempt + 1)
+                get_logger().debug(
+                    "KV %s %s attempt %d/%d failed (%s); retrying in "
+                    "%.0f ms", method, path, attempt + 1, self.retry_max,
+                    e, delay * 1e3)
+                _time.sleep(delay)
         raise AssertionError("unreachable")
 
     def _read_response(self, sock):

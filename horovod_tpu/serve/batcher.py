@@ -78,6 +78,12 @@ class Request:
             # silently be answered with one (and pay the prefill anyway).
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if timeout_s is not None and not float(timeout_s) > 0:
+            # A zero/negative timeout used to collapse to "no deadline"
+            # (0 is falsy) and park the handler for the server-side cap;
+            # reject it loudly instead — the server maps this to 400.
+            raise ValueError(
+                f"timeout_s must be positive, got {timeout_s}")
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -95,6 +101,14 @@ class Request:
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
                 and (now or time.monotonic()) >= self.deadline)
+
+    def remaining(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds of deadline budget left (None without a deadline;
+        clamped at 0).  The server returns this on 503/504 so a client
+        knows how much retry budget its request still has."""
+        if self.deadline is None:
+            return None
+        return max(self.deadline - (now or time.monotonic()), 0.0)
 
     def complete(self) -> None:
         self._done.set()
@@ -292,3 +306,11 @@ class DynamicBatcher:
             taken, self._queue = self._queue, []
             self._cond.notify_all()
             return taken
+
+    def reopen(self) -> None:
+        """Re-admit a closed batcher (mark_alive scale-up: the revived
+        replica's queue starts empty and accepting).  A no-op on an open
+        batcher."""
+        with self._cond:
+            self._closed = False
+            self._cond.notify_all()

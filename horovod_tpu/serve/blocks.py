@@ -109,15 +109,23 @@ class BlockManager:
             out = []
             for _ in range(n):
                 if not self._free:
-                    victim, _ = self._retained.popitem(last=False)  # LRU
-                    del self._registry[self._hash_of[victim]]
-                    self._hash_of[victim] = None
-                    self._free.append(victim)
-                    self.evictions += 1
+                    self._evict_retained_locked()
                 bid = self._free.popleft()
                 self._ref[bid] = 1
                 out.append(bid)
             return out
+
+    def _evict_retained_locked(self) -> int:
+        """Evict the LRU retained block (caller holds the lock):
+        unregister its hash and return it to the free list.  The single
+        home of the registry/retained/free-list invariant — allocation
+        pressure and corruption scrubs both go through here."""
+        victim, _ = self._retained.popitem(last=False)  # LRU
+        del self._registry[self._hash_of[victim]]
+        self._hash_of[victim] = None
+        self._free.append(victim)
+        self.evictions += 1
+        return victim
 
     def ref(self, block_id: int) -> None:
         with self._lock:
@@ -191,6 +199,23 @@ class BlockManager:
                 return
             self._registry[chain_hash] = block_id
             self._hash_of[block_id] = chain_hash
+
+    def invalidate_retained(self, n: int = 1) -> int:
+        """Scrub up to ``n`` retained (refcount-0, prefix-registered)
+        blocks: unregister and return them to the free list, LRU first.
+        This is the recovery action for "this block's contents are
+        suspect" (faultline's ``pool-corrupt-block``, or a real ECC/HBM
+        scrub): a corrupted block must leave the registry — a later
+        prefix hit on it would serve wrong K/V silently — while blocks
+        still referenced by live sequences are *not* touched (their
+        owners re-prefill on the failure path, not here).  Returns how
+        many blocks were scrubbed."""
+        with self._lock:
+            scrubbed = 0
+            while scrubbed < n and self._retained:
+                self._evict_retained_locked()
+                scrubbed += 1
+            return scrubbed
 
     # -- copy-on-write --------------------------------------------------------
 

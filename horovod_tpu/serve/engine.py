@@ -56,9 +56,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..faultline import runtime as _faultline
+from ..faultline.plan import FaultInjected
 from ..utils import get_logger
-from .batcher import (DynamicBatcher, Request, bucket_requests,
-                      prompt_bucket)
+from .batcher import (DeadlineExceededError, DynamicBatcher, Request,
+                      bucket_requests, prompt_bucket)
 from .blocks import BlockManager, NoFreeBlocksError, chain_hashes
 from .metrics import ServeMetrics
 
@@ -686,6 +688,9 @@ class InferenceEngine:
         self._admit_counter = 0
         self._step_anchor: Optional[float] = None
         self.steps = 0
+        # Fault injection (faultline): env-configured plans bootstrap at
+        # construction; the per-iteration guard is a None check.
+        _faultline.maybe_install_from_env()
 
     # -- introspection -------------------------------------------------------
 
@@ -707,7 +712,23 @@ class InferenceEngine:
 
     def start(self) -> "InferenceEngine":
         if self._thread is not None:
-            return self
+            if self._thread.is_alive() and not self._stop.is_set():
+                return self  # already running
+            # A prior stop() timed out on a wedged iteration (stop()
+            # keeps the handle in that case): the old loop must be OUT
+            # before the restart — clearing _stop under a live loop
+            # would leave two threads racing the batcher, the slot
+            # table, and the donated cache arrays.
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"{self.replica_id}: previous engine loop has not "
+                    f"exited; cannot restart")
+            self._thread = None
+        # A revived engine (drain()/stop() then mark_alive) restarts on
+        # the same object: the stop flag must clear or the new thread
+        # exits before its first iteration.
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"hvd-serve-engine-{self.replica_id}")
@@ -718,7 +739,11 @@ class InferenceEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
-            self._thread = None
+            # Keep the handle if the join timed out (an iteration wedged
+            # past 30 s): start() must be able to see the still-running
+            # loop and refuse to spawn a second one next to it.
+            if not self._thread.is_alive():
+                self._thread = None
 
     def drain(self) -> List[Request]:
         """Stop the loop and return all in-flight requests WITHOUT
@@ -758,6 +783,18 @@ class InferenceEngine:
     def _fail_doomed(self, r: Request) -> bool:
         """Requests that can never run on this engine fail loudly at
         admission.  Returns True when the request was failed."""
+        # Deadline propagation (docs/fault_injection.md): a request whose
+        # budget is already gone is never prefilled — prefill is the
+        # expensive phase, and its output could only ever be thrown away.
+        # The batcher pops expired requests at admission too; this covers
+        # the window between its queue walk and the prefill call (and
+        # requeued work whose budget died in transit).
+        if r.expired():
+            r.fail(DeadlineExceededError(
+                f"{r.request_id} expired before prefill "
+                f"({time.monotonic() - r.submitted_at:.3f}s since submit)"))
+            self.metrics.count_request("expired")
+            return True
         total = len(r.prompt) + r.max_new_tokens
         if total > self.adapter.max_len:
             r.fail(ValueError(
@@ -778,6 +815,54 @@ class InferenceEngine:
             self.metrics.count_request("error")
             return True
         return False
+
+    def _expire_inflight(self) -> int:
+        """Engine-side deadline check, once per iteration: an in-flight
+        sequence whose client deadline passed is failed NOW (its handler
+        is about to answer 504 anyway) and its slot + KV blocks return to
+        the pool instead of decoding tokens nobody will read.  Returns
+        the number of sequences expired."""
+        expired = 0
+        now = time.monotonic()
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is None or not s.request.expired(now):
+                    continue
+                s.request.fail(DeadlineExceededError(
+                    f"{s.request.request_id} deadline expired mid-flight "
+                    f"({len(s.request.generated)} token(s) generated)"))
+                self.metrics.count_request("expired")
+                table = getattr(s, "table", None)
+                if self.blocks is not None and table is not None:
+                    self.blocks.free_table(table)
+                self._slots[i] = None
+                expired += 1
+        return expired
+
+    # -- fault injection (faultline) -----------------------------------------
+
+    def _faultline_step(self) -> None:
+        """``engine.step`` injection point, consulted at the top of every
+        loop iteration (the step boundary).  ``poison-step`` raises into
+        the loop's recovery path exactly like an organic XLA/runtime
+        failure; ``slow-decode`` stalls the iteration; ``pool-corrupt-
+        block`` drops retained prefix blocks (their contents are now
+        suspect, so they must leave the registry rather than serve stale
+        K/V to a later prefix hit)."""
+        for f in _faultline.fire("engine.step", self.replica_id):
+            if f.kind == "slow-decode":
+                time.sleep(f.param or 0.02)
+            elif f.kind == "pool-corrupt-block":
+                if self.blocks is not None:
+                    n = self.blocks.invalidate_retained(
+                        max(int(f.param), 1))
+                    get_logger().warning(
+                        "%s: faultline scrubbed %d retained KV block(s)",
+                        self.replica_id, n)
+            elif f.kind == "poison-step":
+                raise FaultInjected(
+                    f"faultline: poisoned step on {self.replica_id} "
+                    f"(step {self.steps})")
 
     # -- slot-mode loop ------------------------------------------------------
 
@@ -1152,6 +1237,9 @@ class InferenceEngine:
         paged = self.kv_mode == "paged"
         while not self._stop.is_set():
             try:
+                if _faultline.PLAN is not None:
+                    self._faultline_step()
+                self._expire_inflight()
                 busy = self.active_count > 0
                 # Iteration-level scheduling: admission happens BETWEEN
                 # decode steps — non-blocking while sequences are active,

@@ -95,6 +95,14 @@ class ServeMetrics:
         self.requests: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
                                          "requeued": 0, "preempted": 0,
                                          "error": 0}
+        # Preemption-watcher health: transient KV errors the poller
+        # survived (a dead watcher means preemptions go unnoticed
+        # forever, so its error count must be observable).
+        self.preempt_poll_errors = 0
+        # Replica lifecycle transitions (mark_dead / mark_alive — the
+        # fleet's shrink/grow events, docs/serving.md scale-up).
+        self.replica_events: Dict[str, int] = {"mark_dead": 0,
+                                               "mark_alive": 0}
         # Batch occupancy: sequences active per decode step.
         self.occupancy_last = 0
         self.occupancy_max = 0
@@ -139,6 +147,15 @@ class ServeMetrics:
     def count_request(self, outcome: str) -> None:
         with self._lock:
             self.requests[outcome] = self.requests.get(outcome, 0) + 1
+
+    def count_preempt_poll_error(self) -> None:
+        with self._lock:
+            self.preempt_poll_errors += 1
+
+    def count_replica_event(self, event: str) -> None:
+        with self._lock:
+            self.replica_events[event] = \
+                self.replica_events.get(event, 0) + 1
 
     def register_queue_depth(self, replica_id: str, fn) -> None:
         """``fn`` is sampled at render time — queue depth is a gauge, not
@@ -202,6 +219,8 @@ class ServeMetrics:
                 "decode_steps": self.decode_steps_total,
                 "prefills": self.prefills_total,
                 "requests": dict(self.requests),
+                "replica_events": dict(self.replica_events),
+                "preempt_poll_errors": self.preempt_poll_errors,
                 "occupancy": {"last": self.occupancy_last,
                               "max": self.occupancy_max,
                               "mean": round(occ_mean, 3)},
@@ -253,6 +272,15 @@ class ServeMetrics:
             for outcome, n in sorted(self.requests.items()):
                 lines.append(
                     f'hvd_serve_requests_total{{outcome="{outcome}"}} {n}')
+            lines.append(
+                "# TYPE hvd_serve_preempt_poll_errors_total counter")
+            lines.append(f"hvd_serve_preempt_poll_errors_total "
+                         f"{self.preempt_poll_errors}")
+            lines.append("# TYPE hvd_serve_replica_events_total counter")
+            for event, n in sorted(self.replica_events.items()):
+                lines.append(
+                    f'hvd_serve_replica_events_total{{event="{event}"}} '
+                    f'{n}')
             lines.append("# TYPE hvd_serve_batch_occupancy gauge")
             lines.append(f"hvd_serve_batch_occupancy {self.occupancy_last}")
             lines.append("# TYPE hvd_serve_batch_occupancy_max gauge")

@@ -20,6 +20,19 @@ set, its queued AND in-flight requests are resubmitted to the survivors
 degrades.  Requeued requests restart from the prompt — greedy decoding
 makes the eventual answer identical, so a client never observes the loss
 beyond latency.
+
+The fleet also GROWS back (docs/serving.md scale-up): when a marked
+host's preemption clears — the sentinel deletes its marker from the same
+KV scope, exactly what happens when a maintenance event cancels or the
+recovered host's new sentinel reconciles at startup — ``watch_preemption``
+translates the clearance into ``mark_alive``: the dead replica's batcher
+reopens, its engine loop restarts on the existing (masked, therefore
+safe) cache arrays, and least-loaded routing rebalances new work onto it
+immediately.  ``add_replica`` admits a genuinely new replica (a freshly
+rendezvoused process set) into the routing set the same way.  The watcher
+itself is hardened: a transient KV error is counted
+(``hvd_serve_preempt_poll_errors_total``), backed off, and survived — a
+silently-dead watcher would mean preemptions go unnoticed forever.
 """
 
 from __future__ import annotations
@@ -28,6 +41,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..faultline import runtime as _faultline
 from ..utils import get_logger
 from .batcher import DynamicBatcher, QueueFullError, Request
 from .engine import InferenceEngine, ModelAdapter
@@ -84,11 +98,16 @@ class ReplicaScheduler:
         self._lock = threading.Lock()
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self._started = False
         for r in self.replicas:
-            self.metrics.register_queue_depth(
-                r.replica_id, r.engine.batcher.depth)
-            self.metrics.register_kv_stats(
-                r.replica_id, r.engine.kv_stats)
+            self._register_metrics(r)
+        _faultline.maybe_install_from_env()
+
+    def _register_metrics(self, r: Replica) -> None:
+        self.metrics.register_queue_depth(
+            r.replica_id, r.engine.batcher.depth)
+        self.metrics.register_kv_stats(
+            r.replica_id, r.engine.kv_stats)
 
     # -- routing -------------------------------------------------------------
 
@@ -100,6 +119,22 @@ class ReplicaScheduler:
         """Least-loaded routing with failover: a replica at queue capacity
         backpressures; the next-least-loaded healthy replica is tried
         before the request is shed."""
+        if _faultline.PLAN is not None:
+            # ``replica.route`` injection point: a kill-rank fault here
+            # models a loss DETECTED at routing time (an all-numeric
+            # target is a slot rank, anything else a replica id) — the
+            # direct path other detectors use via report_rank_lost,
+            # bypassing the sentinel/marker plumbing.  No instance is
+            # passed: the spec's target names the VICTIM, not this
+            # scheduler (one scheduler per process; the plan's instance
+            # filter is for multi-instance points like engines/hosts).
+            for f in _faultline.fire("replica.route"):
+                if f.kind != "kill-rank" or f.target is None:
+                    continue
+                if f.target.isdigit():
+                    self.report_rank_lost(int(f.target))
+                else:
+                    self.mark_dead(f.target, reason="faultline kill-rank")
         candidates = sorted(self._healthy(), key=lambda r: r.load())
         if not candidates:
             self.metrics.count_request("error")
@@ -115,6 +150,7 @@ class ReplicaScheduler:
         raise last_exc  # every healthy queue is full: explicit shed
 
     def start(self) -> "ReplicaScheduler":
+        self._started = True
         for r in self.replicas:
             r.engine.start()
         return self
@@ -159,6 +195,7 @@ class ReplicaScheduler:
             if victim is None or victim.state == "dead":
                 return
             victim.state = "dead"
+        self.metrics.count_replica_event("mark_dead")
         get_logger().warning("serve: replica %s marked dead (%s); draining",
                              replica_id, reason or "operator request")
         # CLOSE (not merely drain) the victim's batcher: a submit() that
@@ -193,31 +230,111 @@ class ReplicaScheduler:
         get_logger().warning("serve: requeued %d request(s) from %s",
                              len(orphans), replica_id)
 
+    # -- scale-up (docs/serving.md) ------------------------------------------
+
+    def mark_alive(self, replica_id: str, reason: str = "") -> None:
+        """Re-admit a dead replica into the routing set: reopen its
+        (closed, empty) batcher, restart its engine loop, flip state.
+
+        Safe on the existing cache arrays: the dead engine's drain freed
+        every slot and block reference, and both cache layouts mask
+        positions beyond a live sequence's length to weight exactly 0 —
+        a revived engine's first prefill overwrites everything it will
+        ever read, so no state reset is needed (and retained prefix
+        blocks keep their still-valid K/V).  Least-loaded routing
+        rebalances onto the empty revived replica on the next submit."""
+        with self._lock:
+            replica = next((r for r in self.replicas
+                            if r.replica_id == replica_id), None)
+            if replica is None or replica.state == "healthy":
+                return
+            replica.state = "healthy"
+        replica.engine.batcher.reopen()
+        if self._started:
+            replica.engine.start()
+        self.metrics.count_replica_event("mark_alive")
+        get_logger().warning("serve: replica %s re-admitted (%s)",
+                             replica_id, reason or "operator request")
+
+    def report_rank_recovered(self, rank: int) -> Optional[str]:
+        """Scale-up analog of ``report_rank_lost``: a recovered slot rank
+        revives the dead replica whose process set contains it.  Returns
+        the revived replica's id (None when the rank maps to no dead
+        replica — e.g. a brand-new process set, which enters via
+        ``add_replica`` instead)."""
+        with self._lock:
+            dead = next((r for r in self.replicas
+                         if r.state == "dead" and rank in r.ranks), None)
+        if dead is None:
+            return None
+        self.mark_alive(dead.replica_id, reason=f"rank {rank} recovered")
+        return dead.replica_id
+
+    def add_replica(self, replica: Replica) -> None:
+        """Admit a NEW replica (a freshly rendezvoused process set) into
+        the routing set — fleet growth beyond reviving a known replica."""
+        with self._lock:
+            if any(r.replica_id == replica.replica_id
+                   for r in self.replicas):
+                raise ValueError(
+                    f"replica id {replica.replica_id} already registered")
+            self.replicas.append(replica)
+        self._register_metrics(replica)
+        if self._started:
+            replica.engine.start()
+        self.metrics.count_replica_event("mark_alive")
+        get_logger().warning("serve: replica %s added (scale-up); "
+                             "fleet size now %d",
+                             replica.replica_id, len(self.replicas))
+
     def watch_preemption(self, kv_client, host_ranks: Dict[str, List[int]],
                          poll_s: Optional[float] = None) -> None:
         """Poll the rendezvous KV ``preempt`` scope (the same markers the
         elastic driver's PreemptionAwareDiscovery consumes) and translate
-        marked hosts into dead replicas.  ``host_ranks`` maps the
-        discovery-plane hostname to the slot ranks it carries (the
-        launcher's host allocation plan; tests pass a synthetic map)."""
+        marker churn into fleet transitions: a host APPEARING kills the
+        replicas its ranks map to, a previously-marked host DISAPPEARING
+        (the sentinel cleared its marker — event cancelled, or the
+        recovered host's startup reconcile) revives them via
+        ``mark_alive``.  ``host_ranks`` maps the discovery-plane hostname
+        to the slot ranks it carries (the launcher's host allocation
+        plan; tests pass a synthetic map).
+
+        The poller must outlive transient KV trouble: every failed
+        iteration is counted (``hvd_serve_preempt_poll_errors_total``),
+        backed off exponentially (capped at 30 s), and retried forever —
+        a watcher that died on the first flake would mean every later
+        preemption goes unnoticed and the fleet only ever shrinks by
+        surprise."""
         from ..elastic.preemption import PREEMPT_SCOPE
         poll_s = poll_s if poll_s is not None else float(
             os.environ.get("HVD_SERVE_PREEMPT_POLL_S", "1"))
 
         def loop():
-            seen = set()
+            marked_prev: set = set()
+            errors = 0
             while not self._watch_stop.is_set():
                 try:
-                    marked = kv_client.scan(PREEMPT_SCOPE)
+                    marked = set(kv_client.scan(PREEMPT_SCOPE))
+                    for host in marked - marked_prev:
+                        for rank in host_ranks.get(host, []):
+                            self.report_rank_lost(rank)
+                    for host in marked_prev - marked:
+                        for rank in host_ranks.get(host, []):
+                            self.report_rank_recovered(rank)
+                    marked_prev = marked
+                    errors = 0
                 except Exception as e:
-                    get_logger().debug("preempt scan failed: %s", e)
-                    marked = {}
-                for host in marked:
-                    if host in seen:
-                        continue
-                    seen.add(host)
-                    for rank in host_ranks.get(host, []):
-                        self.report_rank_lost(rank)
+                    # Count + back off + KEEP POLLING (module doc).  The
+                    # marker diff state is untouched: the next successful
+                    # scan sees exactly the churn this one missed.
+                    errors += 1
+                    self.metrics.count_preempt_poll_error()
+                    backoff = min(poll_s * (2 ** min(errors, 5)), 30.0)
+                    get_logger().warning(
+                        "preempt watcher: poll error #%d (%s); retrying "
+                        "in %.1fs", errors, e, backoff)
+                    self._watch_stop.wait(backoff)
+                    continue
                 self._watch_stop.wait(poll_s)
 
         self._watch_thread = threading.Thread(

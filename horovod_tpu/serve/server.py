@@ -12,10 +12,24 @@ handler thread parks in ``Request.result()`` while engine threads decode
 Status mapping (explicit backpressure contract):
 
 * 200 — tokens generated;
-* 400 — malformed body;
+* 400 — malformed body (including a non-positive ``timeout_s``, which
+  would otherwise silently mean "no deadline" and park the handler for
+  the server-side cap);
 * 503 + ``Retry-After`` — shed: every healthy replica's queue is at
   capacity, or no healthy replica exists (``/healthz`` says which);
 * 504 — the request's own deadline expired (queued or decoding).
+
+Deadline propagation (docs/fault_injection.md): the client's budget
+arrives as the ``timeout_s`` payload field or the ``X-Request-Timeout-S``
+header (payload wins when both are set), becomes ``Request.deadline``,
+and is honored at every stage downstream — batcher admission pops
+expired requests, the engine refuses to prefill a request whose budget
+is gone and fails in-flight sequences whose deadline passes mid-decode.
+Both shed responses (503/504) carry the request's REMAINING budget in
+``X-Deadline-Remaining-S`` (exact seconds), so a client or proxy can
+decide whether a retry still fits its own SLO instead of retrying into
+certain death; ``Retry-After`` remains the server's minimum-wait
+availability hint (1 s), capped by that budget.
 
 ``hvdserve`` (pyproject console script) stands up a replica world over
 the initialized runtime — see ``run_commandline``.
@@ -57,6 +71,20 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self._reply(code, json.dumps(obj).encode(),
                     extra_headers=extra_headers)
 
+    @staticmethod
+    def _budget_headers(request) -> tuple:
+        """503/504 shed headers (module doc).  ``Retry-After`` is the
+        MINIMUM wait a compliant client honors, so it stays the server's
+        availability hint (the legacy 1 s) merely CAPPED by the client's
+        remaining budget — advertising the full budget there would make
+        a well-behaved client sleep its budget away and retry with
+        nothing left.  The exact budget rides X-Deadline-Remaining-S."""
+        remaining = request.remaining()
+        if remaining is None:
+            return (("Retry-After", "1"),)
+        return (("Retry-After", str(min(1, int(remaining)))),
+                ("X-Deadline-Remaining-S", f"{remaining:.3f}"))
+
     # -- routes --------------------------------------------------------------
 
     def do_GET(self):
@@ -81,11 +109,19 @@ class _ServeHandler(BaseHTTPRequestHandler):
             prompt = payload["tokens"]
             if not isinstance(prompt, list) or not prompt:
                 raise ValueError("'tokens' must be a non-empty list")
+            timeout_s = payload.get("timeout_s")
+            if timeout_s is None:
+                # Header form of the client budget (module doc): what a
+                # proxy hop can attach without rewriting the body.
+                header = self.headers.get("X-Request-Timeout-S")
+                timeout_s = float(header) if header is not None else None
+            if timeout_s is not None:
+                timeout_s = float(timeout_s)  # Request rejects <= 0
             request = Request(
                 prompt,
                 max_new_tokens=int(payload.get("max_new_tokens", 16)),
                 eos_id=payload.get("eos_id"),
-                timeout_s=payload.get("timeout_s"),
+                timeout_s=timeout_s,
                 request_id=payload.get("request_id"))
         except (KeyError, TypeError, ValueError) as e:
             self._reply_json(400, {"error": str(e)})
@@ -95,10 +131,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
             tokens = request.result(timeout=self.server.request_timeout_s)
         except (QueueFullError, NoHealthyReplicaError) as e:
             self._reply_json(503, {"error": str(e)},
-                             extra_headers=(("Retry-After", "1"),))
+                             extra_headers=self._budget_headers(request))
             return
         except (DeadlineExceededError, TimeoutError) as e:
-            self._reply_json(504, {"error": str(e)})
+            self._reply_json(504, {"error": str(e)},
+                             extra_headers=self._budget_headers(request))
             return
         except Exception as e:  # engine-side failure — surfaced, not hung
             self._reply_json(500, {"error": str(e)})

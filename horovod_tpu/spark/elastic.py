@@ -129,8 +129,12 @@ def task_pool_loop(addr: str, port: int, task_index: int,
                 client.put(_SCOPE_TASKS, f"task/{task_index}",
                            json.dumps({"host": host,
                                        "ts": time.time()}).encode())
-            except Exception:
-                pass
+            except Exception as e:
+                # A missed heartbeat is recoverable (the driver allows
+                # gaps) but never silent (HVD009): a run of them is this
+                # task being evicted for a transport problem.
+                get_logger().debug(
+                    "task %d heartbeat put failed: %s", task_index, e)
             stop.wait(_HEARTBEAT_S)
 
     hb = threading.Thread(target=heartbeat, daemon=True,
@@ -219,8 +223,9 @@ def task_pool_loop(addr: str, port: int, task_index: int,
                 # can't leak KV keys for the run's lifetime.
                 try:
                     client.delete(_SCOPE_DONE, f"done/{task_index}/{seq}")
-                except Exception:
-                    pass
+                except Exception as e:
+                    get_logger().debug(
+                        "orphaned done-marker delete failed: %s", e)
             seq += 1
     finally:
         stop.set()
@@ -344,8 +349,9 @@ def run_elastic(fn: Callable,
             for k in client.scan(_SCOPE_RESULTS):
                 if int(k.split("/")[0]) < world_version:
                     client.delete(_SCOPE_RESULTS, k)
-        except Exception:
-            pass
+        except Exception as e:
+            get_logger().debug(
+                "stale-results GC failed (retried next reshape): %s", e)
 
     def worker_fn(slot: _hosts.SlotInfo, terminate_event: threading.Event,
                   world_version: int) -> int:
@@ -445,8 +451,9 @@ def run_elastic(fn: Callable,
                                  (_SCOPE_DONE, f"done/{task_id}/{seq}")):
                     try:
                         client.delete(scope, k)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        get_logger().debug(
+                            "launch-marker cleanup delete failed: %s", e)
 
     t0 = time.time()
     while not discovery.find_available_hosts_and_slots():

@@ -52,6 +52,13 @@ RING_TRANSFER = "RING_TRANSFER"
 # training-side op lifecycle in the same viewer.
 SERVE = "SERVE"
 
+# Fault-injection firings (faultline/plan.py): every fault a FaultPlan
+# fires is an instant event under FAULTLINE/<kind>, so a chaos run's
+# trace shows exactly what broke, where (injection point + instance),
+# and at which step index — the reproducibility artifact two same-seed
+# runs must agree on (docs/fault_injection.md).
+FAULTLINE = "FAULTLINE"
+
 # Static per-step collective census (no reference analog — the reference
 # only learns the collective set at runtime through negotiation; on TPU
 # the jaxpr checker reads it off the traced program, analysis/
@@ -178,6 +185,15 @@ class Timeline:
                    "ts": self._ts_us(), "pid": self.rank,
                    "args": {k: (float(v) if isinstance(v, float) else int(v))
                             for k, v in values.items()}})
+
+    def fault_event(self, kind: str, point: str, instance: str,
+                    step: int):
+        """One fault firing (faultline): process-scoped instant event
+        carrying the injection point, instance, and step index."""
+        self._put({"name": f"{FAULTLINE}/{kind}", "ph": "i", "s": "p",
+                   "ts": self._ts_us(), "pid": self.rank, "tid": point,
+                   "args": {"point": point, "instance": instance,
+                            "step": int(step)}})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,

@@ -51,7 +51,10 @@ def _bench_env(tag, **overrides):
                 "BENCH_SERVE_REPLICAS", "BENCH_SERVE_SLOT_BATCH",
                 "HVD_SERVE_BLOCK_TOKENS", "HVD_SERVE_PREFILL_CHUNK",
                 "HVD_SERVE_PREFIX_CACHE", "HVD_SERVE_KV_MODE",
-                "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH"):
+                "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
+                "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
+                "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
+                "HVD_KV_RETRY_CAP_MS"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -196,6 +199,18 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         for key in ("enabled", "hit_rate", "hit_tokens", "cow_copies"):
             assert key in prefix, f"prefix.{key} missing: {prefix}"
         assert prefix["hit_rate"] > 0  # shared-prefix storm really hit
+        # ISSUE 6: the fault arm — the bench trajectory records
+        # robustness (recovery time + goodput under a seeded plan), not
+        # just throughput.
+        faults = last["faults"]
+        for key in ("seed", "fired", "recovery_s", "goodput_ratio",
+                    "requeued", "replica_events"):
+            assert key in faults, f"faults.{key} missing: {faults}"
+        assert faults["recovery_s"] >= 0   # kill→re-admit→answering
+        assert 0 < faults["goodput_ratio"] <= 1
+        assert faults["fired"], "the seeded plan never fired"
+        assert faults["replica_events"]["mark_alive"] >= 1  # scale-up
+        assert faults["outputs_match"] is True  # faults never corrupt
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -1,0 +1,197 @@
+"""Chaos soak (ISSUE 6 acceptance, ``slow``): a 4-replica world under a
+seeded 3-fault plan — rank kill (via the REAL sentinel/marker/watcher
+machinery), KV transport flakes, and a poisoned engine step — must
+converge back to ``healthz: ok`` with every accepted request answered
+correctly, including at least one replica re-admitted via ``mark_alive``
+after its "rank" recovers.
+
+The fault sequence is a pure function of ``HVD_FAULTLINE_SEED``
+(tests/test_faultline.py pins schedule/firing determinism in isolation;
+here the same contract is asserted on the live plan's schedule), so a
+failing soak reproduces exactly by re-running with the same seed.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import faultline as fl
+from horovod_tpu.elastic.preemption import PreemptionSentinel
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.runner.http_server import KVStoreClient, KVStoreServer
+from horovod_tpu.serve import ServeServer, TransformerAdapter, build_replicas
+
+pytestmark = [pytest.mark.slow, pytest.mark.xdist_group("heavy_e2e")]
+
+CFG = TransformerConfig(vocab_size=89, num_layers=2, num_heads=2,
+                        d_model=32, d_ff=64, max_len=96, causal=True,
+                        dtype=jnp.float32, scan_layers=False)
+NEW_TOKENS = 24
+N_REQUESTS = 64
+SEED = 1234
+
+
+def _gen(port, prompt, n=NEW_TOKENS, timeout=180):
+    body = json.dumps({"tokens": prompt, "max_new_tokens": n}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _gen_with_retry(port, prompt):
+    """A chaos client: 5xx (a poisoned batch surfaces as 500, a no-
+    survivor window as 503) is retried — the fault costs latency, never a
+    lost or wrong answer.  4xx would re-raise (nothing here sends any)."""
+    last = None
+    for _ in range(6):
+        try:
+            return _gen(port, prompt)
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                raise
+            last = e
+            time.sleep(0.25)
+    raise AssertionError(f"request never recovered: {last}")
+
+
+def _specs():
+    return [
+        # Fires through the sentinel's poll: marker published, watcher
+        # kills the replica, window ends, marker clears, watcher revives.
+        # Early step + fast polls: the kill must land ~0.1 s after the
+        # sentinel lights up, while the storm is still in flight.
+        fl.FaultSpec("kill-rank", target="chaos-host", step=2, repeat=8),
+        # A 2-drop train against the control plane: inside the KV
+        # client's default 3-attempt retry budget, so the watcher and
+        # sentinel ride it out.
+        fl.FaultSpec("drop-kv-response", step=3, repeat=2),
+        # One poisoned iteration on a survivor replica mid-storm.
+        fl.FaultSpec("poison-step", target="replica-1", step=40),
+    ]
+
+
+def test_chaos_soak_converges_to_ok_with_no_lost_or_wrong_answers(
+        hvd8, monkeypatch):
+    # Hermetic chaos world: no metadata server (the sentinel reads the
+    # unreachable endpoint as NONE while a plan is installed).
+    monkeypatch.setenv("HVD_TPU_MAINTENANCE_URL", "http://127.0.0.1:9/x")
+    model = Transformer(CFG)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sched = build_replicas(lambda: TransformerAdapter(CFG, params),
+                           num_replicas=4, max_batch=4)
+    server = ServeServer(sched)
+    port = server.start(port=0, host="127.0.0.1")
+    kv = KVStoreServer()
+    kv_port = kv.start(0)
+    client = KVStoreClient("127.0.0.1", kv_port)
+    victim = sched.replicas[0]
+    sentinel = None
+    try:
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, CFG.vocab_size,
+                               size=(int(rng.randint(3, 24)),)).tolist()
+                   for _ in range(N_REQUESTS)]
+        # Fault-free reference pass (also warms every prefill bucket).
+        singles = {tuple(p): _gen(port, p)["tokens"] for p in prompts[:8]}
+
+        sched.watch_preemption(client, {"chaos-host": list(victim.ranks)},
+                               poll_s=0.03)
+        plan = fl.install(fl.FaultPlan(_specs(), seed=SEED))
+        # Reproducibility contract on the LIVE plan: the schedule is a
+        # pure function of (seed, specs).
+        assert plan.schedule() == fl.FaultPlan(_specs(),
+                                               seed=SEED).schedule()
+
+        # Storm first, then light the sentinel: its poll counter starts
+        # at 0, so the kill window (steps 2..9 at 0.03 s/poll) lands
+        # ~0.1 s in — while the storm is demonstrably in flight.
+        results = [None] * N_REQUESTS
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = _gen_with_retry(port, prompts[i])
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(N_REQUESTS)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while victim.engine.active_count == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert victim.engine.active_count > 0, "victim never got load"
+        sentinel = PreemptionSentinel(client, hostname="chaos-host",
+                                      poll_interval_s=0.03)
+        sentinel.start()
+
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+
+        # Every fault in the plan fired (the poison step needs the
+        # engine's iteration counter to reach it; wait it out).
+        deadline = time.monotonic() + 60
+        while not plan.exhausted() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert plan.exhausted(), plan.schedule()
+        fired_kinds = {k for _, _, k in plan.firing_sequence()}
+        assert fired_kinds == {"kill-rank", "drop-kv-response",
+                               "poison-step"}
+
+        # CONVERGENCE: the marker cleared and the watcher re-admitted the
+        # victim — back to healthz ok with all 4 replicas healthy.
+        deadline = time.monotonic() + 60
+        health = None
+        while time.monotonic() < deadline:
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+            if health["status"] == "ok" and health["healthy"] == 4:
+                break
+            time.sleep(0.1)
+        assert health["status"] == "ok" and health["healthy"] == 4, health
+
+        # The fleet really went DOWN and CAME BACK (not "nothing
+        # happened"): one mark_dead, one mark_alive, requeued work.
+        snap = sched.metrics.snapshot()
+        assert snap["replica_events"]["mark_dead"] >= 1
+        assert snap["replica_events"]["mark_alive"] >= 1
+        assert snap["requests"]["requeued"] >= 1, snap["requests"]
+
+        # ZERO lost or wrong answers: every one of the 48 accepted
+        # requests matches its single-served reference — including work
+        # requeued off the dead replica and retries after the poison.
+        for p, r in zip(prompts, results):
+            key = tuple(p)
+            if key not in singles:
+                singles[key] = _gen(port, p)["tokens"]
+            assert r["tokens"] == singles[key], (p, r)
+
+        # The revived replica is genuinely serving again.
+        probe = _gen(port, prompts[0])
+        assert probe["tokens"] == singles[tuple(prompts[0])]
+        deadline = time.monotonic() + 30
+        served_by_victim = False
+        while not served_by_victim and time.monotonic() < deadline:
+            out = _gen(port, prompts[1])
+            assert out["tokens"] == singles[tuple(prompts[1])]
+            served_by_victim = out["replica"] == victim.replica_id
+        assert served_by_victim, "revived replica never took traffic"
+    finally:
+        if sentinel is not None:
+            sentinel.stop()
+        fl.uninstall()
+        server.stop()
+        kv.stop()

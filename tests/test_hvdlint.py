@@ -297,6 +297,64 @@ def test_hvd008_clean_untraced_timing():
     assert fired(src) == []
 
 
+def test_hvd009_kv_transport_in_silent_except():
+    src = """\
+    def clear_marker(kv_client, host):
+        try:
+            kv_client.delete("preempt", host)
+        except Exception:
+            pass
+    """
+    assert fired(src) == [("HVD009", 3)]
+
+
+def test_hvd009_bare_except_and_collective():
+    """A bare `except:` counts whatever its body does, and the collective
+    arm fires alongside HVD002 (same code, two severities of the same
+    disease — HVD002's any-non-raising handler vs HVD009's silent
+    shapes)."""
+    src = """\
+    import horovod_tpu as hvd
+
+    def sync(x, log):
+        try:
+            x = hvd.allreduce(x)
+        except:
+            log.append("oops")
+        return x
+    """
+    assert ("HVD009", 5) in fired(src)
+    assert ("HVD002", 5) in fired(src)
+
+
+def test_hvd009_clean_logged_handler_and_non_kv_calls():
+    """A handler that LOGS (or otherwise acts) is not the silent shape;
+    dict.get/plain attribute calls are not KV transport."""
+    src = """\
+    def heartbeat(kv_client, log, d):
+        try:
+            kv_client.put("tasks", "t0", b"hi")
+        except Exception as e:
+            log.warning("heartbeat failed: %s", e)
+        try:
+            d.get("key")
+        except Exception:
+            pass
+    """
+    assert fired(src) == []
+
+
+def test_hvd009_ellipsis_body_is_silent():
+    src = """\
+    def gc(client):
+        try:
+            client.delete_scope("old")
+        except Exception:
+            ...
+    """
+    assert fired(src) == [("HVD009", 3)]
+
+
 def test_join_collective_requires_hvd_base():
     """os.path.join / ','.join / thread.join must not read as the hvd.join
     collective (the false positives the first dogfooding run surfaced)."""

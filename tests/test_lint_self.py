@@ -48,6 +48,11 @@ def test_lint_covers_the_whole_tree():
                 "server.py", "metrics.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
+    # Same for faultline/ (ISSUE 6): the injection layer must stay under
+    # the swallowed-fault rule it motivated (HVD009).
+    for mod in ("plan.py", "runtime.py"):
+        assert any(f.endswith(os.path.join("faultline", mod))
+                   for f in files), f"faultline/{mod} not linted"
     assert not any("__pycache__" in f for f in files)
 
 

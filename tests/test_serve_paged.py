@@ -682,3 +682,60 @@ def test_replica_to_dict_and_build_replicas_kwargs(hvd8):
                            max_batch=4, replica_id="mlp")
     assert meng.kv_mode == "paged"
     assert meng.kv_stats()["block_tokens"] == 1
+
+
+# -- mark_dead during chunked prefill (ISSUE 6 satellite) --------------------
+
+def test_mark_dead_during_chunked_prefill_requeues_and_frees_blocks():
+    """A replica killed while a long prompt is MID-CHUNK must requeue the
+    request with its already-prefilled blocks freed: the dead engine's
+    pool reports used == 0 (no leak) and the survivor reproduces the
+    answer exactly from the prompt."""
+    from horovod_tpu.serve import Replica, ReplicaScheduler
+    model, params = _tiny()
+    metrics = ServeMetrics()
+    # 5 ms/token chunk cost x 5-token chunks: a 40-token prompt spends
+    # ~200 ms streaming through prefill — a wide, deterministic window to
+    # kill inside.
+    victim_eng = InferenceEngine(
+        _CostedAdapter(TransformerAdapter(_TINY, params, block_tokens=BT),
+                       ms_per_token=5.0),
+        kv_mode="paged", prefill_chunk=5, max_batch=8, metrics=metrics,
+        replica_id="victim")
+    survivor_eng = InferenceEngine(
+        TransformerAdapter(_TINY, params, block_tokens=BT),
+        kv_mode="paged", prefill_chunk=5, max_batch=8, metrics=metrics,
+        replica_id="survivor")
+    sched = ReplicaScheduler(
+        [Replica("victim", None, victim_eng),
+         Replica("survivor", None, survivor_eng)], metrics=metrics).start()
+    try:
+        prompt = [int(t) for t in
+                  np.random.RandomState(5).randint(0, 61, size=40)]
+        r = Request(prompt, max_new_tokens=4)
+        victim_eng.batcher.submit(r)  # pin the request to the victim
+
+        def mid_chunk():
+            with victim_eng._lock:
+                return any(s is not None and 0 < s.prompt_pos < len(prompt)
+                           for s in victim_eng._slots)
+
+        deadline = time.monotonic() + 60
+        while not mid_chunk() and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert mid_chunk(), "never observed a mid-chunk prefill"
+        used_at_kill = victim_eng.kv_stats()["used"]
+        assert used_at_kill > 0  # partially-prefilled blocks are held
+        sched.mark_dead("victim", reason="mid-chunk race test")
+
+        out = r.result(timeout=120)
+        assert r.requeues >= 1
+        assert r.replica_id == "survivor"
+        assert out == _flax_greedy(model, params, prompt, 4)  # exact
+        # No pool leak on the dead replica: every reference the partial
+        # prefill held was released (full prompt blocks may be RETAINED —
+        # refcount 0, still prefix-registered — never "used").
+        assert victim_eng.kv_stats()["used"] == 0
+        assert metrics.snapshot()["requests"]["requeued"] >= 1
+    finally:
+        sched.stop()
