@@ -11,14 +11,27 @@ Two cooperating layers (see docs/static_analysis.md):
   the closed jaxpr (cond/scan/while/shard_map sub-jaxprs included) to
   verify collective/axis consistency (HVD101/HVD102) and to build the
   per-step collective census surfaced by timeline.py and bench.py.
+* **hvdrace static half** (lockgraph.py): global lock-acquisition-order
+  graph + thread-lifecycle analysis over the same paths — lock-order
+  cycles (HVD200), blocking calls under locks (HVD201), callbacks under
+  locks (HVD202), unjoined non-daemon threads (HVD203).  CLI: ``--race``.
+* **hvdrace runtime half** (witness.py): the ``HVD_SANITIZE=1``
+  lock-witness sanitizer — wraps ``threading`` locks, maintains the
+  order graph live, records HVD210 (observed inversion) / HVD211
+  (timeout-less wait holding a second lock) findings.
 
 CLI: ``python -m horovod_tpu.analysis <paths>`` (or the ``hvdlint``
 console script / ``tools/hvdlint.py`` shim); exit 0 clean, 1 findings,
-2 internal error.  Trace-time mode: ``HVD_ANALYZE=1`` (hook.py).
+2 internal error.  Trace-time mode: ``HVD_ANALYZE=1`` (hook.py);
+runtime lock witness: ``HVD_SANITIZE=1`` (witness.py).
 """
 
 from .findings import ERROR, WARNING, Finding, Rule, RULES, unsuppressed  # noqa: F401
 from .linter import lint_file, lint_paths, lint_source, iter_python_files  # noqa: F401
 from .jaxpr_check import JaxprReport, check_closed_jaxpr, check_step_fn  # noqa: F401
+from .lockgraph import (analyze_paths as race_paths,  # noqa: F401
+                        analyze_source as race_source,
+                        analyze_sources as race_sources)
 from .cli import main  # noqa: F401
 from . import hook  # noqa: F401
+from . import witness  # noqa: F401

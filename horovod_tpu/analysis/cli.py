@@ -33,10 +33,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdlint",
         description="Distributed-correctness static analyzer for "
-                    "horovod_tpu training code (rules HVD001-HVD008; see "
-                    "docs/static_analysis.md)")
+                    "horovod_tpu training code (rules HVD001-HVD009; "
+                    "--race runs the hvdrace lock-order/thread-lifecycle "
+                    "analysis, HVD200-HVD203; see docs/static_analysis.md)")
     p.add_argument("paths", nargs="*", default=["."],
                    help="files or directories to lint (default: .)")
+    p.add_argument("--race", action="store_true",
+                   help="run hvdrace instead: the lock-order & "
+                        "thread-lifecycle analysis (rules HVD200-HVD203) "
+                        "over the given paths as ONE global lock graph; "
+                        "same output formats, pragmas, and exit codes")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--select", type=_split_ids, default=[],
                    help="comma-separated rule IDs to run exclusively")
@@ -62,8 +68,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         _print_rules()
         return 0
     try:
-        findings = lint_paths(args.paths, select=args.select,
-                              ignore=args.ignore)
+        if args.race:
+            from .lockgraph import analyze_paths
+            findings = analyze_paths(args.paths, select=args.select,
+                                     ignore=args.ignore)
+        else:
+            findings = lint_paths(args.paths, select=args.select,
+                                  ignore=args.ignore)
     except Exception as e:  # internal error: distinct from "has findings"
         print(f"hvdlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)

@@ -97,6 +97,50 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "collective desynchronizes ranks)",
          "count the error into metrics, log it, back off and retry "
          "(serve/replica.watch_preemption is the model), or re-raise"),
+    # -- lock-order / thread-lifecycle (hvdrace static) rules ---------------
+    Rule("HVD200", ERROR,
+         "lock-order cycle: two code paths acquire the same pair of locks "
+         "in opposite orders (the AB/BA deadlock shape the serve batcher/"
+         "metrics pair shipped with once) — if the paths ever run "
+         "concurrently the threads deadlock holding each other's lock",
+         "pick ONE global order for the pair and restructure the inner "
+         "acquisition out of the outer critical section (sample state "
+         "under one lock, act on it after release); declare the intended "
+         "order with '# hvdrace: order=A<B' so inversions keep firing"),
+    Rule("HVD201", WARNING,
+         "blocking call (KV/HTTP request, subprocess, time.sleep, "
+         "Thread.join, jit-compiled step) while holding a lock — every "
+         "other thread needing that lock stalls for the call's full "
+         "latency, and a hung transport wedges the whole control plane",
+         "move the blocking call outside the critical section: snapshot "
+         "what it needs under the lock, release, then block"),
+    Rule("HVD202", ERROR,
+         "callback/user-hook invoked while holding a lock — the callee is "
+         "arbitrary code that may take its own lock (the exact shape of "
+         "the batcher on_shed → metrics-lock half of the PR 3 AB/BA "
+         "deadlock) or re-enter the calling object",
+         "collect the callbacks to fire under the lock, release it, then "
+         "invoke them (batcher.get_admission's expired-list finally "
+         "block is the model)"),
+    Rule("HVD203", ERROR,
+         "non-daemon thread spawned with no join() on any stop/close "
+         "path — interpreter exit blocks on it forever, and an exception "
+         "between spawn and a sole in-line join leaks it",
+         "pass daemon=True (loop threads that poll a stop Event), or "
+         "store the handle and join it from every stop()/close() path"),
+    # -- lock-witness (hvdrace runtime, HVD_SANITIZE=1) rules ---------------
+    Rule("HVD210", ERROR,
+         "runtime lock-order inversion: the witness observed lock B "
+         "acquired while holding A after an earlier A-while-holding-B "
+         "acquisition — a live demonstration of an HVD200 cycle",
+         "fix the acquisition order (see HVD200); the finding carries "
+         "both acquisition sites"),
+    Rule("HVD211", ERROR,
+         "Condition.wait()/Event.wait() with no timeout while holding a "
+         "second lock — the wait releases only its own lock, so the "
+         "other lock is held until a wakeup that may never come",
+         "wait with a bounded timeout and re-check, or release the "
+         "second lock before waiting"),
     # -- trace-level (jaxpr) rules -----------------------------------------
     Rule("HVD100", ERROR,
          "the step function failed to trace — the jaxpr checker reports the "
@@ -132,7 +176,7 @@ class Finding:
     severity: str = ""
     fix_hint: str = ""
     suppressed: bool = False
-    source: str = "lint"  # "lint" | "jaxpr"
+    source: str = "lint"  # "lint" | "jaxpr" | "race" | "witness"
 
     def __post_init__(self):
         rule = RULES.get(self.rule)

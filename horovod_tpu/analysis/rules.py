@@ -25,6 +25,10 @@ Design notes:
   aliased imports (``from jax.lax import psum as reduce``) are out of
   scope by design — cheap to evade, but lint is a seatbelt, not a
   sandbox.
+* The HVD2xx lock-order / thread-lifecycle rules live in lockgraph.py:
+  they need a GLOBAL cross-module lock graph, not the per-module pass
+  this file implements (they reuse ``_Module``'s traced-fn closure and
+  the name tables here).
 """
 
 from __future__ import annotations

@@ -137,6 +137,14 @@ class ElasticDriver:
         with self._lock:
             for w in self._workers.values():
                 w.terminate_event.set()
+        # Deterministic discovery-loop teardown: the loop re-checks
+        # _shutdown within one DISCOVER_INTERVAL_S; join it so stop()
+        # leaves no poller behind (daemon stays the backstop for a wedged
+        # discovery script).  _resume calls stop() from its own thread,
+        # never from the discovery thread itself, but guard anyway.
+        t = self._discovery_thread
+        if t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=DISCOVER_INTERVAL_S + 5)
 
     def join(self) -> None:
         """Wait until the job settles: no live workers and no resume pending

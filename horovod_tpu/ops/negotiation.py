@@ -354,7 +354,13 @@ class Negotiator:
                     return
                 batch, self._buf = self._buf, []
             try:
-                self.client.put_batch(f"disp@{self._gen}", dict(batch))
+                # Shipping INSIDE _flush_lock is the lock's whole job: it
+                # serializes batch puts so re-queued records can never
+                # interleave with a younger batch (stream-order holes).
+                # Only the flusher and close() ever contend, both
+                # ship-or-park paths — blocking here is the design.
+                self.client.put_batch(  # hvdlint: disable=HVD201
+                    f"disp@{self._gen}", dict(batch))
             except Exception:
                 # Re-queue: a transient KV failure must not punch a
                 # permanent hole in the replay stream (a joined peer
@@ -412,11 +418,20 @@ class Negotiator:
         in a daemon thread with a short join; abandoning records at
         process exit is fine — nobody will replay a dead generation."""
         self._closed = True
+        # Wake a parked flusher so it observes _closed and exits now
+        # instead of on its next 1 s poll; then join it bounded — close()
+        # must leave no flusher behind on the happy path (daemon stays
+        # the backstop when it is wedged in a dead-KV connect).
+        self._buf_event.set()
         t = threading.Thread(target=lambda: self._swallow(
             self.flush_dispatches), daemon=True,
             name=f"hvd-dispatch-close-{self.rank}")
         t.start()
         t.join(2.0)
+        flusher = self._flusher
+        if flusher is not None and \
+                flusher is not threading.current_thread():
+            flusher.join(2.0)
 
     @staticmethod
     def _swallow(fn) -> None:

@@ -364,6 +364,14 @@ class KVStoreServer:
             self.httpd.shutdown()
             self.httpd.server_close()
             self.httpd = None
+        if self._thread is not None:
+            # serve_forever was told to exit; join it so stop() leaves no
+            # acceptor thread behind (daemon=True stays the interpreter-
+            # exit backstop — a handler blocked in a long-poll must never
+            # pin exit, module doc).
+            self._thread.join(timeout=10)
+            if not self._thread.is_alive():
+                self._thread = None
 
 
 class RendezvousServer(KVStoreServer):

@@ -30,7 +30,15 @@ Quickstart (CPU-exercisable end to end)::
     # curl -d '{"tokens": [1,2,3], "max_new_tokens": 8}' :8000/generate
 """
 
-from .batcher import (  # noqa: F401
+# Lock-witness sanitizer (HVD_SANITIZE=1, analysis/witness.py): install
+# BEFORE the submodule imports below so every serve-plane lock — batcher
+# condition, engine slot table, metrics, scheduler, block pool — is
+# constructed through the instrumented factory.  One env read when off.
+from ..analysis import witness as _witness  # noqa: E402
+
+_witness.maybe_install_from_env()
+
+from .batcher import (  # noqa: F401,E402
     DeadlineExceededError, DynamicBatcher, QueueFullError, Request,
     bucket_requests, prompt_bucket,
 )

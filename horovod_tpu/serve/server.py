@@ -177,9 +177,16 @@ class ServeServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="hvd-serve-http")
         self._thread.start()
-        bound = self.httpd.server_address[1]
-        get_logger().info("hvdserve listening on :%d (%d replica(s))",
-                          bound, len(self.scheduler.replicas))
+        try:
+            bound = self.httpd.server_address[1]
+            get_logger().info("hvdserve listening on :%d (%d replica(s))",
+                              bound, len(self.scheduler.replicas))
+        except Exception:
+            # An exception between spawn and the caller's eventual stop()
+            # must not leak the listener thread (hvdrace HVD203 stop-path
+            # contract): tear the acceptor down before re-raising.
+            self.stop()
+            raise
         return bound
 
     @property
@@ -191,6 +198,13 @@ class ServeServer:
             self.httpd.shutdown()
             self.httpd.server_close()
             self.httpd = None
+        if self._thread is not None:
+            # Deterministic listener teardown: serve_forever has been told
+            # to exit; join so no acceptor thread outlives stop() (daemon
+            # remains the interpreter-exit backstop for a wedged accept).
+            self._thread.join(timeout=10)
+            if not self._thread.is_alive():
+                self._thread = None
         self.scheduler.stop()
         self.metrics.maybe_emit_timeline(force=True)
 

@@ -59,6 +59,12 @@ SERVE = "SERVE"
 # runs must agree on (docs/fault_injection.md).
 FAULTLINE = "FAULTLINE"
 
+# Lock-witness findings (analysis/witness.py, HVD_SANITIZE=1): every
+# observed lock-order inversion / naked wait is an instant event under
+# WITNESS/<rule>, so a sanitized run's trace shows the near-deadlock at
+# the moment it happened, next to the serve/fault events.
+WITNESS = "WITNESS"
+
 # Static per-step collective census (no reference analog — the reference
 # only learns the collective set at runtime through negotiation; on TPU
 # the jaxpr checker reads it off the traced program, analysis/
@@ -194,6 +200,17 @@ class Timeline:
                    "ts": self._ts_us(), "pid": self.rank, "tid": point,
                    "args": {"point": point, "instance": instance,
                             "step": int(step)}})
+
+    def witness_event(self, rule: str, site_path: str, site_line: int,
+                      thread_name: str):
+        """One lock-witness finding (analysis/witness.py HVD210/HVD211):
+        process-scoped instant event carrying the violating acquisition
+        site and the thread that performed it."""
+        self._put({"name": f"{WITNESS}/{rule}", "ph": "i", "s": "p",
+                   "ts": self._ts_us(), "pid": self.rank,
+                   "tid": thread_name,
+                   "args": {"site": f"{site_path}:{int(site_line)}",
+                            "thread": thread_name}})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,

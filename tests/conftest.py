@@ -44,3 +44,22 @@ def hvd8():
     hvd.init()
     yield hvd
     hvd.shutdown()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_session():
+    """HVD_SANITIZE=1 runs the whole suite under the lock-witness
+    sanitizer (analysis/witness.py): locks constructed during the run are
+    order-checked live, and the session FAILS at teardown on any
+    inversion/naked-wait finding left standing (tests that seed
+    violations deliberately reset the witness themselves).  A no-op (one
+    env read) when the env is unset."""
+    from horovod_tpu.analysis import witness
+    installed = witness.maybe_install_from_env()
+    yield
+    if installed:
+        findings = witness.findings()
+        witness.uninstall()
+        assert not findings, (
+            "HVD_SANITIZE: the suite left lock-witness findings "
+            "standing:\n" + "\n".join(f.format() for f in findings))

@@ -54,7 +54,7 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
-                "HVD_KV_RETRY_CAP_MS"):
+                "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"

@@ -1,0 +1,907 @@
+"""hvdrace corpus: seeded HVD2xx violations + clean fixtures + witness.
+
+Mirrors tests/test_hvdlint.py's contract for the lock-order &
+thread-lifecycle analysis (analysis/lockgraph.py): every HVD2xx rule
+fires exactly where the corpus plants it, and must NOT fire on the
+adjacent clean fixture (re-entrant RLock self-acquisition is not
+HVD200; a daemon or stop-path-joined thread is not HVD203).
+
+The acceptance corpus reproduces the PR 3 batcher-lock/metrics-lock
+AB/BA deadlock shape; it must be reported as HVD200 by the static pass
+AND — exec'd as real code under the ``HVD_SANITIZE=1`` witness
+(analysis/witness.py) — caught live as HVD210.
+"""
+
+import json
+import textwrap
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.analysis import RULES, witness
+from horovod_tpu.analysis.cli import main as cli_main
+from horovod_tpu.analysis.lockgraph import analyze_source, analyze_sources
+
+
+def findings_of(src, **kw):
+    return analyze_source(textwrap.dedent(src), path="corpus.py", **kw)
+
+
+def fired(src, **kw):
+    return [(f.rule, f.line) for f in findings_of(src, **kw)
+            if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# The PR 3 AB/BA shape: one corpus, two detectors (acceptance criterion).
+# ---------------------------------------------------------------------------
+
+# The batcher/metrics deadlock exactly as PR 3 shipped it: the batcher's
+# expiry path reaches into the metrics lock while holding the batcher
+# lock, and the /metrics render samples queue depth (batcher lock) while
+# holding the metrics lock.
+AB_BA_CORPUS = """\
+import threading
+
+
+class Metrics:
+    def __init__(self, batcher: "Batcher" = None):
+        self._lock = threading.Lock()
+        self.requests = {}
+        self.batcher = batcher
+
+    def count_request(self, outcome):
+        with self._lock:
+            self.requests[outcome] = self.requests.get(outcome, 0) + 1
+
+    def render(self):
+        with self._lock:
+            return {"queue_depth": self.batcher.depth()}
+
+
+class Batcher:
+    def __init__(self, metrics: "Metrics"):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.metrics = metrics
+        metrics.batcher = self
+
+    def depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def pop_expired(self):
+        with self._lock:
+            expired, self._queue = self._queue, []
+            for r in expired:
+                self.metrics.count_request("expired")
+"""
+
+
+def test_pr3_ab_ba_shape_is_hvd200_statically():
+    findings = [f for f in analyze_source(AB_BA_CORPUS, path="abba.py")
+                if not f.suppressed]
+    assert [f.rule for f in findings] == ["HVD200"]
+    (f,) = findings
+    # Both witness paths printed: batcher-then-metrics and the render
+    # direction's callback edge (here a direct call so the static pass
+    # can close it).
+    assert "Batcher._lock" in f.message and "Metrics._lock" in f.message
+    assert "path 1" in f.message and "path 2" in f.message
+
+
+def test_pr3_ab_ba_shape_is_caught_live_by_witness():
+    """The same corpus exec'd as real code under the installed witness:
+    driving the two paths (single-threaded — no actual deadlock needed)
+    must record an HVD210 inversion."""
+    was_installed = witness.installed()
+    witness.install()
+    witness.reset()
+    try:
+        ns = {}
+        exec(compile(AB_BA_CORPUS, "abba_corpus", "exec"), ns)
+        metrics = ns["Metrics"]()
+        batcher = ns["Batcher"](metrics)
+        batcher._queue.append("r1")
+        batcher.pop_expired()   # batcher lock -> metrics lock
+        metrics.render()        # metrics lock -> batcher lock: inversion
+        rules = [f.rule for f in witness.findings()]
+        assert rules == ["HVD210"], rules
+        (f,) = witness.findings()
+        assert "abba_corpus" in f.message or "abba_corpus" in f.path
+    finally:
+        witness.reset()
+        if not was_installed:
+            witness.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# HVD200: cycles, self-deadlock, declared orders
+# ---------------------------------------------------------------------------
+
+def test_hvd200_non_reentrant_self_reacquire():
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self.inner()
+
+        def inner(self):
+            with self._lock:
+                pass
+    """
+    assert fired(src) == [("HVD200", 9)]
+
+
+def test_hvd200_reentrant_rlock_is_clean():
+    """RLock self-acquisition is re-entrant by contract: NOT a cycle."""
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.RLock()
+
+        def outer(self):
+            with self._lock:
+                self.inner()
+
+        def inner(self):
+            with self._lock:
+                pass
+    """
+    assert fired(src) == []
+
+
+def test_hvd200_condition_shares_its_locks_identity():
+    """Condition(self._lock) IS self._lock: with-cond then with-lock in
+    the same class must not self-cycle."""
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def submit(self):
+            with self._cond:
+                pass
+
+        def depth(self):
+            with self._lock:
+                return 0
+    """
+    assert fired(src) == []
+
+
+def test_hvd200_cross_module_cycle():
+    """The lock graph is global: each half of the cycle in its own
+    module (the real serve layout)."""
+    mod_a = textwrap.dedent("""\
+        import threading
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+
+            def hold_then_call(self):
+                with self._lock:
+                    self.b.poke()
+
+            def poke(self):
+                with self._lock:
+                    pass
+        """)
+    mod_b = textwrap.dedent("""\
+        import threading
+
+        class B:
+            def __init__(self, a):
+                self._lock = threading.Lock()
+                self.a = a
+
+            def hold_then_call(self):
+                with self._lock:
+                    self.a.poke()
+
+            def poke(self):
+                with self._lock:
+                    pass
+        """)
+    # B.__init__'s `a` param is unannotated on purpose: resolution comes
+    # from an annotated attribute elsewhere — so annotate it here.
+    mod_b = mod_b.replace("def __init__(self, a):",
+                          "def __init__(self, a: \"A\"):")
+    findings = [f for f in analyze_sources([(mod_a, "a.py"), (mod_b, "b.py")])
+                if not f.suppressed]
+    assert [f.rule for f in findings] == ["HVD200"]
+    assert "A._lock" in findings[0].message
+    assert "B._lock" in findings[0].message
+
+
+def test_hvd200_declared_order_inversion_fires_without_opposing_path():
+    src = """\
+    import threading
+
+    # hvdrace: order=C.lock_a<C.lock_b
+
+    class C:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def inverted(self):
+            with C.lock_b:
+                with C.lock_a:
+                    pass
+    """
+    out = fired(src)
+    assert out == [("HVD200", 11)]
+    (f,) = [f for f in findings_of(src) if not f.suppressed]
+    assert "inverts the declared order" in f.message
+
+
+def test_hvd200_matching_declared_order_is_clean():
+    src = """\
+    import threading
+
+    # hvdrace: order=C.lock_a<C.lock_b
+
+    class C:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ordered(self):
+            with C.lock_a:
+                with C.lock_b:
+                    pass
+    """
+    assert fired(src) == []
+
+
+def test_hvd200_contradictory_declarations_are_reported():
+    src = """\
+    import threading
+    # hvdrace: order=x:a<x:b
+    # hvdrace: order=x:b<x:a
+    a = threading.Lock()
+    b = threading.Lock()
+    """
+    out = fired(src)
+    assert ("HVD200", 2) in out
+    (f,) = [f for f in findings_of(src) if f.line == 2]
+    assert "contradictory" in f.message
+
+
+def test_hvd200_disable_pragma_on_violating_line():
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+
+        def ab(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+
+        def ba(self):
+            with self._lock_b:
+                with self._lock_a:  # hvdlint: disable=HVD200
+                    pass
+    """
+    findings = findings_of(src)
+    assert [(f.rule, f.suppressed) for f in findings] == [("HVD200", True)]
+
+
+# ---------------------------------------------------------------------------
+# HVD201: blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+def test_hvd201_sleep_kv_subprocess_join_under_lock():
+    src = """\
+    import subprocess
+    import threading
+    import time
+
+    class C:
+        def __init__(self, kv_client):
+            self._lock = threading.Lock()
+            self.kv_client = kv_client
+            self._thread = threading.Thread(target=print, daemon=True)
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1)
+                self.kv_client.scan("preempt")
+                subprocess.run(["true"])
+                self._thread.join()
+    """
+    assert fired(src) == [("HVD201", 13), ("HVD201", 14),
+                          ("HVD201", 15), ("HVD201", 16)]
+
+
+def test_hvd201_jitted_call_under_lock():
+    src = """\
+    import threading
+    import jax
+
+    @jax.jit
+    def decode_step(x):
+        return x + 1
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self, x):
+            with self._lock:
+                return decode_step(x)
+    """
+    assert fired(src) == [("HVD201", 14)]
+
+
+def test_hvd201_with_nested_in_try_and_loop_still_tracked():
+    """Acquisitions inside if/for/try bodies must register (the walker
+    once only scanned calls through compound statements) — the batcher's
+    own `with self._cond:` sits inside a try."""
+    src = """\
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def m(self, flag):
+            try:
+                if flag:
+                    with self._lock:
+                        time.sleep(1)
+            finally:
+                pass
+
+        def loop(self):
+            for _ in range(3):
+                with self._lock:
+                    time.sleep(2)
+    """
+    assert fired(src) == [("HVD201", 12), ("HVD201", 19)]
+
+
+def test_hvd202_finally_after_with_is_not_under_the_lock():
+    """The fixed batcher shape: callback fired in a finally AFTER the
+    with-block released — must stay clean."""
+    src = """\
+    import threading
+
+    class Batcher:
+        def __init__(self, on_shed):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._on_shed = on_shed
+
+        def get_admission(self):
+            expired = []
+            try:
+                with self._cond:
+                    expired.append(1)
+            finally:
+                for r in expired:
+                    self._on_shed(r, "expired")
+    """
+    assert fired(src) == []
+
+
+def test_hvd201_clean_blocking_outside_lock():
+    src = """\
+    import threading
+    import time
+
+    class C:
+        def __init__(self, kv_client):
+            self._lock = threading.Lock()
+            self.kv_client = kv_client
+
+        def good(self):
+            with self._lock:
+                snapshot = 1
+            time.sleep(0.01)
+            self.kv_client.scan("preempt")
+            return snapshot
+    """
+    assert fired(src) == []
+
+
+def test_hvd201_dict_get_named_kv_is_not_transport():
+    """kv_stats.get(...) is a dict read, not a round-trip (the dogfood
+    false positive that narrowed the heuristic)."""
+    src = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.kv_stats = {}
+
+        def snapshot(self):
+            with self._lock:
+                return self.kv_stats.get("used", 0)
+    """
+    assert fired(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD202: callback under a lock
+# ---------------------------------------------------------------------------
+
+def test_hvd202_on_shed_callback_under_lock():
+    src = """\
+    import threading
+
+    class Batcher:
+        def __init__(self, on_shed):
+            self._lock = threading.Lock()
+            self._on_shed = on_shed
+
+        def pop_expired(self):
+            with self._lock:
+                self._on_shed(None, "expired")
+    """
+    assert fired(src) == [("HVD202", 10)]
+
+
+def test_hvd202_registered_fn_container_under_lock():
+    src = """\
+    import threading
+
+    class Metrics:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._queue_depth_fns = {}
+
+        def render(self):
+            with self._lock:
+                return {k: fn() for k, fn in
+                        self._queue_depth_fns.items()}
+
+        def render2(self):
+            with self._lock:
+                return self._queue_depth_fns["a"]()
+    """
+    assert ("HVD202", 15) in fired(src)
+
+
+def test_hvd202_module_level_resolvable_callee_is_exempt():
+    """A module-level function holding a module-level lock calling an
+    in-module helper whose NAME merely matches the callback pattern is
+    resolvable, not arbitrary (review regression: the exemption only
+    applied inside classes)."""
+    src = """\
+    import threading
+
+    _LOCK = threading.Lock()
+
+    def flush_hook():
+        return 1
+
+    def flush():
+        with _LOCK:
+            flush_hook()
+    """
+    assert fired(src) == []
+
+
+def test_hvd202_clean_callback_fired_after_release():
+    src = """\
+    import threading
+
+    class Batcher:
+        def __init__(self, on_shed):
+            self._lock = threading.Lock()
+            self._on_shed = on_shed
+
+        def pop_expired(self):
+            expired = []
+            with self._lock:
+                expired.append(1)
+            for r in expired:
+                self._on_shed(r, "expired")
+    """
+    assert fired(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD203: thread lifecycle
+# ---------------------------------------------------------------------------
+
+def test_hvd203_unjoined_non_daemon_attr_thread():
+    src = """\
+    import threading
+
+    class Srv:
+        def start(self):
+            self._thread = threading.Thread(target=print)
+            self._thread.start()
+    """
+    assert fired(src) == [("HVD203", 5)]
+
+
+def test_hvd203_fire_and_forget():
+    src = """\
+    import threading
+
+    def go():
+        threading.Thread(target=print).start()
+    """
+    assert fired(src) == [("HVD203", 4)]
+
+
+def test_hvd203_daemon_thread_is_clean():
+    src = """\
+    import threading
+
+    def go():
+        threading.Thread(target=print, daemon=True).start()
+    """
+    assert fired(src) == []
+
+
+def test_hvd203_joined_on_stop_path_is_clean():
+    src = """\
+    import threading
+
+    class Srv:
+        def start(self):
+            self._thread = threading.Thread(target=print)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join(timeout=5)
+    """
+    assert fired(src) == []
+
+
+def test_hvd203_other_classes_join_does_not_suppress():
+    """A sibling class joining its own same-named `_thread` attr must not
+    hide this class's leaked thread (review regression: joined_attrs was
+    checked module-wide)."""
+    src = """\
+    import threading
+
+    class Leaky:
+        def start(self):
+            self._thread = threading.Thread(target=print)
+            self._thread.start()
+
+    class Clean:
+        def start(self):
+            self._thread = threading.Thread(target=print)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join(timeout=5)
+    """
+    assert fired(src) == [("HVD203", 5)]
+
+
+def test_hvd203_local_join_and_daemon_attr_are_clean():
+    src = """\
+    import threading
+
+    def joined():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join()
+
+    def daemonized_after():
+        t = threading.Thread(target=print)
+        t.daemon = True
+        t.start()
+
+    def pool():
+        threads = [threading.Thread(target=print),
+                   threading.Thread(target=print)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    """
+    assert fired(src) == []
+
+
+# ---------------------------------------------------------------------------
+# Witness runtime unit coverage (beyond the AB/BA acceptance test)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def installed_witness():
+    was = witness.installed()
+    witness.install()
+    witness.reset()
+    yield witness
+    witness.reset()
+    if not was:
+        witness.uninstall()
+
+
+def test_witness_consistent_order_is_clean(installed_witness):
+    # Separate lines: same-line construction would share one witness
+    # class and record no edges at all (a vacuous pass).
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    # The A-before-B edge was really observed — the clean result is not
+    # for want of bookkeeping.
+    assert any(k[1] != k[0] for k in witness.order_graph())
+    assert witness.findings() == []
+
+
+def test_witness_rlock_reentry_is_clean(installed_witness):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert witness.findings() == []
+
+
+def test_witness_inversion_across_threads(installed_witness):
+    # Separate lines: witness identity is the construction SITE (two
+    # locks born on one line would share a witness class).
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def path_ab():
+        with a:
+            with b:
+                pass
+
+    def path_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=path_ab, daemon=True)
+    t1.start()
+    t1.join(5)
+    t2 = threading.Thread(target=path_ba, daemon=True)
+    t2.start()
+    t2.join(5)
+    assert [f.rule for f in witness.findings()] == ["HVD210"]
+    # Deduped: driving the inversion again reports nothing new.
+    path_ba()
+    assert len(witness.findings()) == 1
+
+
+def test_witness_naked_condition_wait_holding_second_lock(
+        installed_witness):
+    other = threading.Lock()
+    cond = threading.Condition()
+
+    def waiter():
+        with other:
+            with cond:
+                cond.wait()   # timeout-less + second lock held: HVD211
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = 50
+    while deadline and not witness.findings():
+        threading.Event().wait(0.05)
+        deadline -= 1
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert [f.rule for f in witness.findings()] == ["HVD211"]
+
+
+def test_witness_thread_start_under_lock_is_not_a_naked_wait(
+        installed_witness):
+    """Thread.start() blocks on its internal timeout-less _started.wait;
+    starting a thread while holding a lock (the negotiator's
+    _start_flusher shape) must NOT be HVD211 — the started event is set
+    promptly by construction (review regression: this fired on real repo
+    code under HVD_SANITIZE=1).  A USER-level naked Event.wait under a
+    lock stays a finding."""
+    guard = threading.Lock()
+    with guard:
+        t = threading.Thread(target=lambda: None, daemon=True)
+        t.start()
+    t.join(5)
+    assert witness.findings() == []
+    # Contrast: user code naked-waiting an Event while holding the lock.
+    ev = threading.Event()
+    waiter_err = []
+
+    def waiter():
+        try:
+            with guard:
+                ev.wait()
+        except Exception as e:  # pragma: no cover - diagnosis aid
+            waiter_err.append(e)
+
+    t2 = threading.Thread(target=waiter, daemon=True)
+    t2.start()
+    deadline = 100
+    while deadline and not witness.findings():
+        time.sleep(0.02)
+        deadline -= 1
+    ev.set()
+    t2.join(5)
+    assert not waiter_err
+    assert [f.rule for f in witness.findings()] == ["HVD211"]
+
+
+def test_witness_raise_mode_releases_the_violating_acquisition(
+        installed_witness):
+    """HVD_RACE_RAISE debug mode: the LockOrderViolation raised from
+    __enter__ must not leave the just-acquired raw lock held (review
+    regression: a leaked lock turned the diagnosis into a wedge)."""
+    from horovod_tpu.analysis.witness import (LockOrderViolation, _state)
+    _state.raise_on_violation = True
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderViolation):
+            with b:
+                with a:
+                    pass
+        assert not a.locked() and not b.locked()
+        with a:  # must not deadlock on the leaked lock
+            pass
+    finally:
+        _state.raise_on_violation = False
+
+
+def test_witness_bounded_wait_is_clean(installed_witness):
+    other = threading.Lock()
+    cond = threading.Condition()
+    with other:
+        with cond:
+            cond.wait(timeout=0.01)
+    assert witness.findings() == []
+
+
+def test_witness_declare_order_preseeds_canonical_direction(
+        installed_witness):
+    witness.declare_order("site:a", "site:b")
+    assert ("site:a", "site:b") in witness.order_graph()
+
+
+def test_witness_findings_surface_in_reports_and_timeline(
+        installed_witness, monkeypatch):
+    """Findings publish to core.analysis_reports() (a WitnessReport) and
+    emit WITNESS/<rule> timeline instants like the faultline firings."""
+    from horovod_tpu import core as _core
+    from horovod_tpu.analysis.witness import WitnessReport
+
+    events = []
+
+    class _TL:
+        def witness_event(self, rule, path, line, thread):
+            events.append((rule, path, line, thread))
+
+    monkeypatch.setattr(_core._state, "timeline", _TL())
+    monkeypatch.setattr(_core._state, "analysis_reports", [])
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    reports = [r for r in _core.analysis_reports()
+               if isinstance(r, WitnessReport)]
+    assert len(reports) == 1 and not reports[0].ok()
+    assert [f.rule for f in reports[0].findings] == ["HVD210"]
+    assert [e[0] for e in events] == ["HVD210"]
+    assert events[0][3] == threading.current_thread().name
+
+
+def test_witness_events_and_queues_work_while_installed(installed_witness):
+    import queue
+    e = threading.Event()
+    e.set()
+    assert e.wait(0.1)
+    q = queue.Queue()
+    q.put("x")
+    assert q.get(timeout=1) == "x"
+    assert witness.findings() == []
+
+
+# ---------------------------------------------------------------------------
+# CLI --race contract (exit codes, JSON, catalogue)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def race_corpus_dir(tmp_path):
+    (tmp_path / "dirty.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class Srv:
+            def start(self):
+                self._thread = threading.Thread(target=print)
+                self._thread.start()
+        """))
+    (tmp_path / "clean.py").write_text(textwrap.dedent("""\
+        import threading
+
+        def go():
+            threading.Thread(target=print, daemon=True).start()
+        """))
+    return tmp_path
+
+
+def test_cli_race_exit_codes_and_text(race_corpus_dir, capsys):
+    rc = cli_main(["--race", str(race_corpus_dir)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD203" in out and "dirty.py" in out
+    rc = cli_main(["--race", str(race_corpus_dir / "clean.py")])
+    assert rc == 0
+
+
+def test_cli_race_json(race_corpus_dir, capsys):
+    rc = cli_main(["--race", str(race_corpus_dir), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["by_rule"] == {"HVD203": 1}
+    (f,) = payload["findings"]
+    assert f["rule"] == "HVD203" and f["source"] == "race"
+
+
+def test_cli_race_syntax_error_is_hvd000_not_crash(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = cli_main(["--race", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD000" in out
+
+
+def test_cli_race_missing_path_is_a_finding(capsys):
+    rc = cli_main(["--race", "/nonexistent/hvdrace/path"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD000" in out and "does not exist" in out
+
+
+def test_cli_race_select_ignore(race_corpus_dir, capsys):
+    rc = cli_main(["--race", str(race_corpus_dir), "--ignore", "HVD203"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli_main(["--race", str(race_corpus_dir), "--select", "HVD201"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_hvd2xx_catalogue_metadata():
+    for rule_id in ("HVD200", "HVD201", "HVD202", "HVD203",
+                    "HVD210", "HVD211"):
+        assert rule_id in RULES
+    src = """\
+    import threading
+
+    def go():
+        threading.Thread(target=print).start()
+    """
+    (f,) = findings_of(src)
+    assert f.severity == RULES["HVD203"].severity
+    assert f.fix_hint == RULES["HVD203"].fix_hint
+    assert f.source == "race"

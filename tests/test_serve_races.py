@@ -1,0 +1,230 @@
+"""Concurrency pinning for the serve fleet: the HVD_SANITIZE=1 stress
+test plus the thread-lifecycle stop-path contracts.
+
+The stress test hammers ``ReplicaScheduler.submit`` / ``mark_dead`` /
+``mark_alive`` / a ``/metrics``-style render loop / the batcher's
+deadline-expiry path concurrently for a couple of seconds with the
+lock-witness sanitizer (analysis/witness.py) installed, and asserts ZERO
+witness findings — pinning the PR 3 batcher-lock/metrics-lock AB/BA
+deadlock class forever: if anyone reintroduces a lock nesting between
+those components in either direction, the witness sees the inversion the
+first time both paths run.
+
+The stop-path tests pin the HVD203 contract on the repo's own long-lived
+threads: ``ServeServer.stop`` / ``KVStoreServer.stop`` join their
+serve_forever acceptors, ``ElasticDriver.stop`` joins the discovery
+loop, and ``Negotiator.close`` joins the dispatch flusher — no stop path
+leaves a thread behind (daemon remains the interpreter-exit backstop for
+genuinely wedged I/O).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.analysis import witness
+from horovod_tpu.models import create_mlp
+from horovod_tpu.serve import (DynamicBatcher, InferenceEngine, MLPAdapter,
+                               QueueFullError, Replica, ReplicaScheduler,
+                               Request, ServeMetrics, ServeServer)
+
+VOCAB = 17
+
+
+def _mlp_adapter(seed=3, vocab=VOCAB, max_len=64):
+    mlp = create_mlp(features=(8, vocab))
+    params = mlp.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, vocab)))["params"]
+    return MLPAdapter(mlp, params, vocab_size=vocab, max_len=max_len)
+
+
+def _fleet(metrics, n=2, max_batch=4):
+    replicas = []
+    for i in range(n):
+        rid = f"replica-{i}"
+        eng = InferenceEngine(_mlp_adapter(seed=i + 1),
+                              batcher=DynamicBatcher(max_queue=64),
+                              metrics=metrics, max_batch=max_batch,
+                              replica_id=rid)
+        replicas.append(Replica(rid, None, eng))
+    return ReplicaScheduler(replicas, metrics=metrics)
+
+
+def test_serve_fleet_stress_zero_witness_findings(monkeypatch):
+    """A few seconds of submit/mark_dead/mark_alive/render/deadline-expiry
+    chaos under HVD_SANITIZE=1: the fleet must hold a single consistent
+    lock order (zero HVD210/HVD211 findings)."""
+    monkeypatch.setenv("HVD_SANITIZE", "1")
+    was_installed = witness.installed()
+    assert witness.maybe_install_from_env()
+    witness.reset()
+    scheduler = None
+    try:
+        # Everything constructed AFTER install: every fleet lock is
+        # witness-wrapped.
+        metrics = ServeMetrics()
+        scheduler = _fleet(metrics)
+        scheduler.start()
+        stop = threading.Event()
+        errors = []
+        done = []
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                r = Request([1 + i % (VOCAB - 2)], max_new_tokens=2)
+                try:
+                    scheduler.submit(r)
+                except QueueFullError:
+                    time.sleep(0.002)
+                    continue
+                except Exception as e:  # no-survivor windows are a bug
+                    errors.append(e)
+                    return
+                done.append(r)
+                time.sleep(0.001)
+
+        def expiry_storm():
+            # Tiny budgets: these die in the queue, driving the batcher's
+            # _pop_expired + on_shed path (the PR 3 half-A) while the
+            # render loop (half-B) runs concurrently.
+            while not stop.is_set():
+                r = Request([1], max_new_tokens=2, timeout_s=0.004)
+                try:
+                    scheduler.submit(r)
+                except Exception:
+                    pass
+                time.sleep(0.002)
+
+        def scrape():
+            while not stop.is_set():
+                metrics.render()
+                metrics.snapshot()
+                scheduler.healthz()
+                time.sleep(0.002)
+
+        def flapper():
+            while not stop.is_set():
+                scheduler.mark_dead("replica-0", reason="stress flap")
+                time.sleep(0.05)
+                scheduler.mark_alive("replica-0", reason="stress flap")
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=fn, daemon=True)
+                   for fn in (storm, expiry_storm, scrape, flapper)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert not errors, errors
+        # The fleet really worked: requests flowed and the expiry path
+        # really fired (the stress is vacuous otherwise).
+        assert len(done) > 10
+        snap = metrics.snapshot()
+        assert snap["requests"].get("expired", 0) > 0
+        assert snap["replica_events"]["mark_dead"] >= 1
+        assert snap["replica_events"]["mark_alive"] >= 1
+        # THE assertion: zero lock-order inversions, zero naked waits.
+        findings = witness.findings()
+        assert not findings, "\n".join(f.format() for f in findings)
+    finally:
+        if scheduler is not None:
+            scheduler.stop()
+        witness.reset()
+        if not was_installed:
+            witness.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Stop-path thread lifecycle (the HVD203 contract on the repo's threads)
+# ---------------------------------------------------------------------------
+
+def test_serve_server_stop_joins_listener():
+    scheduler = _fleet(ServeMetrics(), n=1)
+    server = ServeServer(scheduler, request_timeout_s=5)
+    server.start(port=0, host="127.0.0.1")
+    listener = server._thread
+    assert listener is not None and listener.is_alive()
+    server.stop()
+    assert not listener.is_alive()
+    assert server._thread is None
+
+
+def test_kvstore_server_stop_joins_acceptor(monkeypatch):
+    from horovod_tpu.runner.http_server import KVStoreServer
+    monkeypatch.setenv("HVD_TPU_KV_SERVER", "python")
+    srv = KVStoreServer()
+    srv.start()
+    acceptor = srv._thread
+    assert acceptor is not None and acceptor.is_alive()
+    srv.stop()
+    assert not acceptor.is_alive()
+    # Store stays readable after stop (module-doc contract).
+    srv.put("s", "k", b"v")
+    assert srv.get("s", "k") == b"v"
+
+
+def test_elastic_driver_stop_joins_discovery_thread(monkeypatch):
+    from horovod_tpu.elastic.discovery import HostDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    class _FixedDiscovery(HostDiscovery):
+        def find_available_hosts_and_slots(self):
+            return {"localhost": 2}
+
+    monkeypatch.setenv("HVD_TPU_KV_SERVER", "python")
+    rendezvous = RendezvousServer()
+    rendezvous.start()
+    try:
+        driver = ElasticDriver(rendezvous, _FixedDiscovery(),
+                               min_np=1, max_np=2, timeout=10)
+        # Start ONLY the discovery loop (instant-exit worker bodies —
+        # the full launch path is test_elastic's job); stop() must join
+        # the loop deterministically.
+        driver._worker_cmd_fn = lambda slot, ev, version: 0
+        driver._discovery_thread.start()
+        time.sleep(0.2)
+        assert driver._discovery_thread.is_alive()
+        driver.stop()
+        assert not driver._discovery_thread.is_alive()
+        # stop() before start() is a no-op on the (unstarted) thread.
+        driver2 = ElasticDriver(rendezvous, _FixedDiscovery(),
+                                min_np=1, max_np=2, timeout=10)
+        driver2.stop()
+        assert not driver2._discovery_thread.is_alive()
+    finally:
+        rendezvous.stop()
+
+
+def test_negotiator_close_joins_flusher(monkeypatch):
+    from horovod_tpu.config import Config
+    from horovod_tpu.ops.negotiation import Negotiator
+    from horovod_tpu.runner.http_server import KVStoreServer
+
+    monkeypatch.setenv("HVD_TPU_KV_SERVER", "python")
+    srv = KVStoreServer()
+    port = srv.start()
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", str(port))
+    try:
+        n = Negotiator(0, 2, Config.from_env())
+        assert n.enabled
+        n.publish_dispatch("t", 0, {"dtype": "float32", "shape": [4],
+                                    "op": 1}, "allreduce")
+        flusher = n._flusher
+        assert flusher is not None and flusher.is_alive()
+        n.close()
+        flusher.join(timeout=5)  # close() already joined; belt for CI
+        assert not flusher.is_alive()
+        # The pending record was shipped, not stranded.
+        assert n.poll_dispatch(0, 1) is not None
+    finally:
+        srv.stop()
