@@ -50,6 +50,8 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_PREFILL_CHUNK": "64",
                  "HVD_SERVE_PREFIX_CACHE": "1",
                  "HVD_SERVE_KV_MODE": "auto",
+                 "HVD_SERVE_ATTN_IMPL": "auto",
+                 "HVD_SERVE_KV_DTYPE": "native",
                  "HVD_FAULTLINE_SEED": "0",
                  "HVD_FAULTLINE_PLAN": ""}
 
@@ -328,7 +330,19 @@ def bench_serve():
     * ``chunked`` — decode token_step p99 while max_len prompts prefill,
       chunked (``HVD_SERVE_PREFILL_CHUNK``) vs unchunked;
     * ``prefix``  — shared-prefix storm: prefix-cache hit rate and block
-      allocations saved."""
+      allocations saved;
+    * ``kernel``  — gather vs the Pallas paged-attention kernel at an
+      identical config (ISSUE 8): in-band token-stream exactness, decode
+      token_step p50/p99 and tokens/s for both impls.  Off-TPU the
+      kernel runs under the Pallas interpreter (``interpret`` recorded
+      in-band), so the hermetic CPU bench keeps recording the kernel's
+      trend while on-chip capture is unavailable;
+    * ``kv_dtype`` — bf16 vs int8 block storage at a FIXED HBM budget in
+      BYTES (bytes-per-block accounting from the BlockManager):
+      admit_ratio of concurrent sequences, max final-logit error vs the
+      bf16 engine, and batched==single exactness WITHIN the int8 engine
+      (quantization changes logits, so the int8 engine's own
+      single-request run is its reference)."""
     import threading
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
@@ -567,6 +581,118 @@ def bench_serve():
         "evictions": prefix_kv["evictions"],
     }
 
+    # -- arm 3b: gather vs Pallas paged-attention kernel ----------------------
+    # Identical engine config either side; only HVD_SERVE_ATTN_IMPL
+    # differs.  Short max_len keeps the interpreter-unrolled grid small
+    # enough that the full hermetic bench stays runnable on CPU; on TPU
+    # the same arm compiles the real Mosaic kernel.
+    kernel_interpret = jax.default_backend() != "tpu"
+    kernel_len = min(cfg.max_len, 64)
+    kernel_prompts = [p[:kernel_len // 2] for p in
+                      mixed_prompts[:8 if smoke else 16]]
+    kernel_tokens = min(new_tokens, 8)
+
+    def impl_arm(impl):
+        ad = TransformerAdapter(cfg, params, max_len=kernel_len,
+                                block_tokens=block_tokens, attn_impl=impl)
+        outs, dt, snap, _ = timed_storm(
+            lambda: InferenceEngine(ad, max_batch=4, kv_mode="paged",
+                                    prefill_chunk=chunk,
+                                    prefix_cache=False,
+                                    metrics=ServeMetrics(),
+                                    replica_id=f"bench-{impl}"),
+            kernel_prompts, kernel_tokens)
+        return outs, dt, snap
+
+    gather_outs, gather_dt, gather_snap = impl_arm("gather")
+    kernel_outs, kernel_dt, kernel_snap = impl_arm("kernel")
+    arm_kernel = {
+        "interpret": kernel_interpret,
+        "outputs_match": kernel_outs == gather_outs,
+        "gather_tokens_per_sec": round(
+            sum(len(o) for o in gather_outs) / gather_dt, 2),
+        "tokens_per_sec": round(
+            sum(len(o) for o in kernel_outs) / kernel_dt, 2),
+        "gather_token_step_p50_ms": gather_snap["token_step"]["p50_ms"],
+        "gather_token_step_p99_ms": gather_snap["token_step"]["p99_ms"],
+        "token_step_p50_ms": kernel_snap["token_step"]["p50_ms"],
+        "token_step_p99_ms": kernel_snap["token_step"]["p99_ms"],
+        "speedup": round((sum(len(o) for o in kernel_outs) / kernel_dt)
+                         / max(sum(len(o) for o in gather_outs)
+                               / gather_dt, 1e-9), 3),
+    }
+
+    # -- arm 3c: bf16 vs int8 KV blocks at a FIXED HBM budget (bytes) ---------
+    # The bf16 pool spends the byte budget on bytes_per_block(bf16)
+    # blocks; int8 blocks cost ~half (payload + f16 scale rows), so the
+    # same bytes hold ~2x the blocks.  The storm uses UNIFORM-cost
+    # prompts (fixed length, so every sequence reserves the same block
+    # count) and a pool sized to 8 concurrent bf16 sequences — making
+    # the byte budget, not slot count or request mix, the binding
+    # constraint the admit_ratio reads.  Exactness: int8 shifts logits,
+    # so the int8 engine is pinned against ITS OWN single-request run
+    # (batched == single is the engine contract at any storage dtype).
+    # Enough requests to saturate the BIGGER (int8) pool's concurrency,
+    # else the request count caps both arms and the ratio reads 1.0.
+    kv_prompt_len = max(block_tokens - kernel_tokens - 2, 2)
+    kv_arm_prompts = [rng.randint(0, 256, size=(kv_prompt_len,)).tolist()
+                      for _ in range(20)]
+
+    def dtype_arm(ad, nblocks, prompts_, singles=False):
+        # Unchunked prefill: every admitted sequence enters decode in the
+        # SAME iteration, so occupancy reads the pool's true concurrency
+        # bound instead of the chunk budget's staggered ramp-in.
+        mk = lambda rid: InferenceEngine(  # noqa: E731
+            ad, max_batch=64, kv_mode="paged", num_blocks=nblocks,
+            prefill_chunk=0, prefix_cache=False,
+            metrics=ServeMetrics(), replica_id=rid)
+        outs, dt, snap, kv = timed_storm(
+            lambda: mk(f"bench-kv-{ad.kv_dtype}"), prompts_,
+            kernel_tokens)
+        sgl = None
+        if singles:
+            eng = mk(f"bench-kv-{ad.kv_dtype}-single").start()
+            sgl = [eng.generate(p, max_new_tokens=kernel_tokens)
+                   for p in prompts_]
+            eng.stop()
+        return outs, dt, snap, kv, sgl
+
+    ad16, ad8 = (TransformerAdapter(cfg, params, max_len=kernel_len,
+                                    block_tokens=block_tokens,
+                                    kv_dtype=kvd)
+                 for kvd in ("bf16", "int8"))
+    bf16_bpb = ad16.paged_block_bytes()
+    int8_bpb = ad8.paged_block_bytes()
+    seq_cost = -(-(kv_prompt_len + kernel_tokens) // block_tokens)
+    bf16_blocks = 8 * seq_cost
+    budget_bytes = bf16_blocks * bf16_bpb
+    int8_blocks = budget_bytes // int8_bpb
+    outs16, dt16, snap16, _, _ = dtype_arm(
+        ad16, bf16_blocks, kv_arm_prompts)
+    outs8, dt8, snap8, kv8, int8_singles = dtype_arm(
+        ad8, int8_blocks, kv_arm_prompts, singles=True)
+    max_logit_err = max(
+        float(np.max(np.abs(ad8.prompt_logits(p)
+                            - ad16.prompt_logits(p))))
+        for p in kv_arm_prompts[:4])
+    arm_kv_dtype = {
+        "budget_bytes": int(budget_bytes),
+        "bytes_per_block_bf16": int(bf16_bpb),
+        "bytes_per_block_int8": int(int8_bpb),
+        "bf16_blocks": int(bf16_blocks),
+        "int8_blocks": int(int8_blocks),
+        "kv_bytes_per_token_int8": kv8.get("kv_bytes_per_token"),
+        "bf16_admitted_concurrent": snap16["occupancy"]["max"],
+        "admitted_concurrent": snap8["occupancy"]["max"],
+        "admit_ratio": round(snap8["occupancy"]["max"]
+                             / max(snap16["occupancy"]["max"], 1), 3),
+        "bf16_tokens_per_sec": round(
+            sum(len(o) for o in outs16) / dt16, 2),
+        "tokens_per_sec": round(sum(len(o) for o in outs8) / dt8, 2),
+        "max_logit_err": round(max_logit_err, 6),
+        "outputs_match": outs8 == int8_singles,
+    }
+
     # -- arm 4: faults — recovery time + goodput under a seeded plan ----------
     # The robustness trajectory (ISSUE 6): the identical storm runs under
     # a seeded FaultPlan (faultline) — a poisoned engine step on
@@ -656,6 +782,8 @@ def bench_serve():
                   f"{kv_mode} bt{block_tokens} chunk{chunk}"
                   + (" SMOKE" if smoke else ""),
         "kv_mode": kv_mode,
+        "attn_impl": sched.replicas[0].engine.attn_impl,
+        "kv_dtype": sched.replicas[0].engine.kv_dtype,
         "block_tokens": block_tokens,
         "prefill_chunk": chunk,
         "prefix_cache": prefix_on,
@@ -670,6 +798,8 @@ def bench_serve():
         "paged": arm_paged,
         "chunked": arm_chunked,
         "prefix": arm_prefix,
+        "kernel": arm_kernel,
+        "kv_dtype_arm": arm_kv_dtype,
         "faults": arm_faults,
     })
 

@@ -46,7 +46,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
-_LANES = 128  # VMEM lane width: (block_q, _LANES) scratch keeps m/l aligned
+LANES = 128  # VMEM lane width: (block_q, LANES) scratch keeps m/l aligned
 
 # Static mask modes (ring attention's per-hop block masks compile one
 # kernel per mode): NONE = full attend; CAUSAL = q >= k on local indices;
@@ -54,25 +54,72 @@ _LANES = 128  # VMEM lane width: (block_q, _LANES) scratch keeps m/l aligned
 MASK_NONE, MASK_CAUSAL, MASK_STRICT = 0, 1, 2
 
 
-def _causal_mask(s, qi, kb, block_q, block_k, mode):
+def causal_mask(s, q_offset, k_offset, mode):
+    """Apply a mask mode to one ``[Bq, Bk]`` score tile whose queries sit
+    at global positions ``q_offset + row`` and keys at ``k_offset + col``.
+    Offsets may be static ints (the dense flash kernels pass block-index
+    multiples) or traced scalars (the paged serving kernels pass each
+    sequence's absolute chunk start / block-table slot).  Shared by the
+    training flash kernels and serve/paged_attention."""
     if mode == MASK_NONE:
         return s
-    qg = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    kg = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    bq, bk = s.shape
+    qg = q_offset + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kg = k_offset + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     keep = qg >= kg if mode == MASK_CAUSAL else qg > kg
     return jnp.where(keep, s, NEG_INF)
 
 
-def _block_contributes(mode, qi, kb, block_q, block_k):
-    # Blocks entirely outside the mask contribute nothing — skip the MXU
-    # work (their DMA is already in flight; acceptable overfetch).
+def block_contributes(mode, q_lo, q_hi, k_lo):
+    """Whether a key block starting at global position ``k_lo`` can
+    contribute to queries spanning ``[q_lo, q_hi]`` under ``mode`` — the
+    compute-skip predicate for blocks entirely outside the mask (their
+    DMA is already in flight; acceptable overfetch).  Static or traced
+    positions, same contract as :func:`causal_mask`."""
     if mode == MASK_NONE:
         return True
     if mode == MASK_CAUSAL:
-        return kb * block_k <= qi * block_q + block_q - 1
-    return kb * block_k < qi * block_q + block_q - 1  # STRICT
+        return k_lo <= q_hi
+    return k_lo < q_hi  # STRICT
+
+
+def online_softmax_block(s, v, m_ref, l_ref, acc_ref):
+    """One FlashAttention-2 online-softmax accumulation step: fold score
+    tile ``s`` [Bq, Bk] and value block ``v`` [Bk, D] into the running
+    (max ``m_ref``, sum ``l_ref``, accumulator ``acc_ref``) VMEM scratch
+    carried across the sequential K-block grid dimension.  Shared by the
+    training flash kernels and serve/paged_attention.
+
+    The running max is floored at ``NEG_INF / 2`` so a row with EVERY
+    key masked contributes ``p = exp(NEG_INF - NEG_INF/2) = 0`` instead
+    of ``exp(NEG_INF - NEG_INF) = 1`` per masked key — without the floor
+    such a row accumulates weight-1 garbage that nothing ever corrects
+    (reachable via MASK_STRICT's first row, and via paged tables whose
+    clamped hole blocks sit entirely past the sequence).  Rows that see
+    at least one unmasked key anywhere are bit-identical either way: the
+    first real key's ``corr = exp(floor - max)`` underflows to exactly
+    0.0, the same wash-out the unfloored state got from
+    ``exp(NEG_INF - max)``."""
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(jnp.maximum(m_prev,
+                                    jnp.max(s, axis=1, keepdims=True)),
+                        NEG_INF / 2)
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[...] = jnp.broadcast_to(
+        l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+        l_ref.shape)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def online_softmax_flush(m_ref, l_ref, acc_ref):
+    """Finalize the online softmax: returns ``(out [Bq, D], lse [Bq])``
+    from the scratch state after the last contributing block."""
+    l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+    return acc_ref[...] / l_final, m_ref[:, 0] + jnp.log(l_final[:, 0])
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
@@ -86,7 +133,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
         m[...] = jnp.full_like(m, NEG_INF)
         l[...] = jnp.zeros_like(l)
 
-    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
+    contributes = block_contributes(mask_mode, qi * block_q,
+                                    qi * block_q + block_q - 1,
+                                    kb * block_k)
 
     @pl.when(contributes)
     def _step():
@@ -96,23 +145,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [Bq, Bk]
-        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
-        m_prev = m[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l[...] = jnp.broadcast_to(
-            l[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True), l.shape)
-        m[...] = jnp.broadcast_to(m_new, m.shape)
-        acc[...] = acc[...] * corr + jax.lax.dot_general(
-            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        s = causal_mask(s, qi * block_q, kb * block_k, mask_mode)
+        online_softmax_block(s, v, m, l, acc)
 
     @pl.when(kb == num_kb - 1)
     def _flush():
-        l_final = jnp.maximum(l[:, :1], 1e-30)
-        o_ref[0] = (acc[...] / l_final).astype(o_ref.dtype)
-        lse_ref[0] = (m[:, 0] + jnp.log(l_final[:, 0]))
+        out, lse = online_softmax_flush(m, l, acc)
+        o_ref[0] = out.astype(o_ref.dtype)
+        lse_ref[0] = lse
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -124,7 +164,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
+    contributes = block_contributes(mask_mode, qi * block_q,
+                                    qi * block_q + block_q - 1,
+                                    kb * block_k)
 
     @pl.when(contributes)
     def _step():
@@ -135,7 +177,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
+        s = causal_mask(s, qi * block_q, kb * block_k, mask_mode)
         p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
         dp = jax.lax.dot_general(
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -161,7 +203,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    contributes = _block_contributes(mask_mode, qi, kb, block_q, block_k)
+    contributes = block_contributes(mask_mode, qi * block_q,
+                                    qi * block_q + block_q - 1,
+                                    kb * block_k)
 
     @pl.when(contributes)
     def _step():
@@ -172,7 +216,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        s = _causal_mask(s, qi, kb, block_q, block_k, mask_mode)
+        s = causal_mask(s, qi * block_q, kb * block_k, mask_mode)
         p = jnp.exp(s - lse_ref[0][:, None])          # [Bq, Bk]
         dv_acc[...] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -244,8 +288,8 @@ def _flash_fwd(q, k, v, mask_mode, scale, block_q, block_k, interpret):
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,

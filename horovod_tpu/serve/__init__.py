@@ -11,6 +11,9 @@ Layers (docs/serving.md has the architecture):
 
 * :mod:`blocks`  — paged KV block pool, per-sequence block tables,
   full-block prefix cache with copy-on-write;
+* :mod:`paged_attention` — fused Pallas paged-attention kernels over the
+  block tables + int8/fp8 KV block quantization (``HVD_SERVE_ATTN_IMPL``
+  / ``HVD_SERVE_KV_DTYPE``);
 * :mod:`engine`  — paged (default) / slot KV cache, chunked prefill,
   iteration-level decode loop;
 * :mod:`batcher` — bounded queue, size/deadline triggers, shape buckets,
@@ -49,6 +52,10 @@ from .engine import (  # noqa: F401
     InferenceEngine, MLPAdapter, ModelAdapter, TransformerAdapter,
 )
 from .metrics import Histogram, ServeMetrics  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    KV_DTYPES, dequantize_kv, kv_bytes_per_token, paged_attention_reference,
+    paged_decode_attention, paged_prefill_attention, quantize_kv,
+)
 from .replica import (  # noqa: F401
     NoHealthyReplicaError, Replica, ReplicaScheduler, build_replicas,
 )

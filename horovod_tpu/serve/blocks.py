@@ -61,13 +61,20 @@ class BlockManager:
     (module doc).  Thread-safe; owned by one engine."""
 
     def __init__(self, num_blocks: int, block_tokens: int,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 bytes_per_block: Optional[int] = None):
         if num_blocks < 1 or block_tokens < 1:
             raise ValueError(
                 f"need positive pool ({num_blocks} blocks x {block_tokens} "
                 f"tokens)")
         self.num_blocks = num_blocks
         self.block_tokens = block_tokens
+        # HBM bytes one physical block costs (K+V payload across layers
+        # + quantization scale rows; adapter.paged_block_bytes) — what
+        # makes block counts comparable across KV storage dtypes: the
+        # bench's fixed-HBM-budget arms size pools in BYTES and read the
+        # admit_ratio win of int8 blocks off this accounting.
+        self.bytes_per_block = bytes_per_block
         self.prefix_cache_enabled = prefix_cache
         self._lock = threading.Lock()
         self._free: deque = deque(range(num_blocks))
@@ -249,9 +256,18 @@ class BlockManager:
             free = len(self._free)
             retained = len(self._retained)
             lookups = self.prefix_lookup_tokens
+            byte_stats = {}
+            if self.bytes_per_block is not None:
+                byte_stats = {
+                    "bytes_per_block": self.bytes_per_block,
+                    "kv_bytes_per_token":
+                        self.bytes_per_block / self.block_tokens,
+                    "bytes_total": self.bytes_per_block * self.num_blocks,
+                }
             return {
                 "total": self.num_blocks,
                 "block_tokens": self.block_tokens,
+                **byte_stats,
                 "free": free,
                 "retained": retained,
                 "used": self.num_blocks - free - retained,

@@ -10,9 +10,13 @@ storage (Kwon et al., SOSP '23) and Sarathi-Serve's chunked prefill
   a sequence holds exactly the blocks its tokens occupy and addresses
   them through a per-sequence block table, so admission is bounded by
   *free blocks*, not by ``max_batch × max_len`` pre-reservation.  The
-  attention programs gather K/V with ``jnp.take`` over the block tables —
-  the CPU-exercisable form of PagedAttention, shaped so a Pallas gather
-  kernel can replace the take+einsum later without touching scheduling;
+  attention over the tables runs either as a ``jnp.take`` gather +
+  post-hoc mask (the exactness baseline) or as the fused Pallas
+  paged-attention kernels (serve/paged_attention.py) that consume the
+  pool and tables directly — ``HVD_SERVE_ATTN_IMPL`` picks, scheduling
+  is identical either way.  Block storage is optionally int8/fp8
+  quantized with append-time scale rows (``HVD_SERVE_KV_DTYPE``),
+  roughly doubling the sequences a fixed HBM budget admits;
 * **chunked prefill** — long prompts stream through the per-iteration
   token budget ``HVD_SERVE_PREFILL_CHUNK``, so every iteration still runs
   admit → prefill-chunk → decode and a ``max_len`` prompt never stalls
@@ -115,10 +119,29 @@ class TransformerAdapter(ModelAdapter):
     ln2 → fc1/gelu/fc2 residual; f32 layernorm islands, tied LM head) as
     pure functions over the param pytree, with an explicit per-layer KV
     cache the flax module doesn't carry — contiguous per-slot rows in slot
-    mode, a block pool addressed through gathered block tables in paged
-    mode.  Serving math is forced to f32 (``HVD_SERVE_DTYPE`` may widen
+    mode, a block pool addressed through block tables in paged mode.
+    Serving math is forced to f32 (``HVD_SERVE_DTYPE`` may widen
     training bf16 checkpoints) — greedy parity across batch compositions
     is the contract and f32 keeps the argmax far from dtype noise.
+
+    Paged attention runs one of two implementations
+    (``HVD_SERVE_ATTN_IMPL`` / ``attn_impl=``):
+
+    * ``gather`` — ``jnp.take`` over the block tables + post-hoc mask +
+      dense softmax (the exactness baseline; materializes gathered
+      [B, S, H, Dh] K/V copies);
+    * ``kernel`` — the fused Pallas paged-attention kernels
+      (serve/paged_attention.py): block tables index the BlockSpecs
+      directly, holes are masked inside the kernel, no gathered copy.
+      Runs compiled on TPU, under the Pallas interpreter elsewhere;
+    * ``auto`` (default) — ``kernel`` on TPU, ``gather`` off-TPU.
+
+    Paged KV block storage dtype (``HVD_SERVE_KV_DTYPE`` / ``kv_dtype=``):
+    ``native`` (the compute dtype, default), ``f32``/``bf16`` (explicit
+    unquantized storage), or ``int8``/``fp8`` (quantized blocks with
+    per-(position, head) scale rows written at append time and
+    dequantized inside the attention — halves KV bytes again vs bf16, so
+    a fixed HBM budget admits ~2x the concurrent sequences).
 
     Constraints (asserted): dense local attention only — a serving replica
     is data-parallel and holds the full model, so ``seq_parallel``/MoE
@@ -128,7 +151,9 @@ class TransformerAdapter(ModelAdapter):
     kv_token_cost = 1  # cache positions consumed per token (MLP: 0)
 
     def __init__(self, cfg, params, max_len: Optional[int] = None,
-                 block_tokens: Optional[int] = None):
+                 block_tokens: Optional[int] = None,
+                 attn_impl: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
         if cfg.seq_parallel is not None or cfg.moe_experts:
             raise ValueError(
@@ -150,6 +175,35 @@ class TransformerAdapter(ModelAdapter):
         self.params = jax.tree.map(
             lambda a: jnp.asarray(a, dtype=dtype), params)
         self._dtype = dtype
+        impl = (attn_impl if attn_impl is not None
+                else os.environ.get("HVD_SERVE_ATTN_IMPL", "auto")).lower()
+        if impl == "auto":
+            # The fused kernel is the TPU fast path; the gather baseline
+            # stays the off-TPU default (the kernel still RUNS anywhere
+            # via the Pallas interpreter — slower, bit-stable — which is
+            # how CPU tier-1 tests and the hermetic bench exercise it).
+            impl = "kernel" if jax.default_backend() == "tpu" else "gather"
+        if impl not in ("gather", "kernel"):
+            raise ValueError(
+                f"attn_impl must be gather|kernel|auto, got {impl!r}")
+        self.attn_impl = impl
+        self._interpret = jax.default_backend() != "tpu"
+        kvd = (kv_dtype if kv_dtype is not None
+               else os.environ.get("HVD_SERVE_KV_DTYPE", "native")).lower()
+        from .paged_attention import KV_DTYPES, SCALE_DTYPE
+        if kvd not in ("native", "f32", "bf16") and kvd not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be native|f32|bf16|int8|fp8, got {kvd!r}"
+                + ("" if kvd != "fp8"
+                   else " (this jax build has no float8_e4m3fn)"))
+        self.kv_dtype = kvd
+        self._kv_quantized = kvd in ("int8", "fp8")
+        self._kv_store_dtype = (
+            {"native": dtype, "f32": jnp.float32,
+             "bf16": jnp.bfloat16}[kvd] if not self._kv_quantized
+            else {"int8": jnp.int8,
+                  "fp8": getattr(jnp, "float8_e4m3fn", None)}[kvd])
+        self._scale_dtype = SCALE_DTYPE
         self._prefill_cache: Dict[Tuple[int, int], object] = {}
         self._chunk_cache: Dict[Tuple[int, int, int], object] = {}
         self._decode_fns: Dict[int, object] = {}
@@ -175,14 +229,88 @@ class TransformerAdapter(ModelAdapter):
     def init_paged_cache(self, num_blocks: int, max_batch: int):
         """Block pool ``[L, num_blocks, block_tokens, H, Dh]``: one
         physical layout shared by every sequence; logical placement lives
-        in the per-sequence block tables (serve/blocks.py)."""
-        import jax.numpy as jnp
+        in the per-sequence block tables (serve/blocks.py).  Quantized
+        storage (int8/fp8) adds per-(block, position, head) scale pools
+        ``[L, num_blocks, block_tokens, H]`` written alongside every K/V
+        append."""
         self._num_blocks = num_blocks
         self._max_batch = max_batch
+        return self._pool_arrays(num_blocks)
+
+    def _pool_arrays(self, num_blocks: int):
+        """The pool pytree for ``num_blocks`` blocks, no adapter-state
+        mutation (``prompt_logits`` builds throwaway pools through
+        this)."""
+        import jax.numpy as jnp
         shape = (self.num_layers, num_blocks, self.block_tokens,
                  self.cfg.num_heads, self.head_dim)
-        return {"k": jnp.zeros(shape, self._dtype),
-                "v": jnp.zeros(shape, self._dtype)}
+        pool = {"k": jnp.zeros(shape, self._kv_store_dtype),
+                "v": jnp.zeros(shape, self._kv_store_dtype)}
+        if self._kv_quantized:
+            pool["k_scale"] = jnp.zeros(shape[:-1], self._scale_dtype)
+            pool["v_scale"] = jnp.zeros(shape[:-1], self._scale_dtype)
+        return pool
+
+    def paged_block_bytes(self) -> int:
+        """HBM bytes one physical block costs across all layers (K + V
+        payload plus scale rows when quantized) — the BlockManager's
+        bytes-per-block accounting, which is what makes the fixed-budget
+        admit_ratio win of quantized storage measurable."""
+        from .paged_attention import kv_bytes_per_token
+        per_tok_head = kv_bytes_per_token(
+            self.kv_dtype if self._kv_quantized else "native",
+            self.head_dim, self._kv_store_dtype)
+        return (self.num_layers * 2 * self.block_tokens
+                * self.cfg.num_heads * per_tok_head)
+
+    def _quantized_scatter(self, pool, layer, wblk, woff, k, v):
+        """Append-time quantization: one scale per (position, head) row,
+        written once next to its int8/fp8 payload (module doc of
+        serve/paged_attention.py has the why-not-per-block rationale).
+        Out-of-bounds rows (the hole sentinel) drop from the scale pools
+        by the same scatter rule as the payload."""
+        from .paged_attention import quantize_kv
+        kq, ks = quantize_kv(k, self.kv_dtype)
+        vq, vs = quantize_kv(v, self.kv_dtype)
+        pool["k"] = pool["k"].at[layer, wblk, woff].set(kq)
+        pool["v"] = pool["v"].at[layer, wblk, woff].set(vq)
+        pool["k_scale"] = pool["k_scale"].at[layer, wblk, woff].set(ks)
+        pool["v_scale"] = pool["v_scale"].at[layer, wblk, woff].set(vs)
+        return pool
+
+    def _paged_attend(self, q, pool, layer, tables, q_positions):
+        """One layer's paged attention over the pool, either impl.
+
+        ``q`` is [n, H, Dh] (decode) or [n, c, H, Dh] (prefill chunk);
+        ``q_positions`` [n] is the absolute position of each row's FIRST
+        query (decode: the token's own position).  Returns the attention
+        output in the compute dtype."""
+        from . import paged_attention as _pa
+        scale = 1.0 / math.sqrt(self.head_dim)
+        ks = pool.get("k_scale")
+        vs = pool.get("v_scale")
+        if self.attn_impl == "kernel":
+            fn = (_pa.paged_decode_attention if q.ndim == 3
+                  else _pa.paged_prefill_attention)
+            out = fn(q, pool["k"][layer], pool["v"][layer], tables,
+                     q_positions,
+                     k_scale=None if ks is None else ks[layer],
+                     v_scale=None if vs is None else vs[layer],
+                     scale=scale, interpret=self._interpret)
+            return out.astype(self._dtype)
+        # gather baseline: ONE implementation, shared with the parity
+        # tests and the bench — paged_attention_reference does the take
+        # over the tables (mode="clip": hole sentinels clamp onto the
+        # last REAL block, so correctness depends on the validity mask
+        # covering every clamped entry — pinned by the poisoned-pool
+        # regression; the default "fill" mode would inject NaN), the
+        # post-hoc positional mask, the dequantizing load, and the dense
+        # softmax.  A mask/dequant fix there lands here by construction.
+        out = _pa.paged_attention_reference(
+            q, pool["k"][layer], pool["v"][layer], tables, q_positions,
+            k_scale=None if ks is None else ks[layer],
+            v_scale=None if vs is None else vs[layer], scale=scale)
+        return out.astype(self._dtype)
 
     # -- functional forward pieces ------------------------------------------
 
@@ -300,67 +428,88 @@ class TransformerAdapter(ModelAdapter):
 
     # -- chunked prefill (paged mode) ----------------------------------------
 
+    def _chunk_forward(self, params, cache, tokens, starts, lengths,
+                       tables, NB: int, c: int):
+        """The chunk-prefill forward (both attention impls, both KV
+        storage dtypes): scatter each chunk's (possibly quantized) K/V
+        into the pool, attend over the block tables, return ``(pool,
+        final-position logits)``.  Shared by the jitted per-bucket
+        programs (argmax on top) and ``prompt_logits`` (the bench/test
+        logit-error probe — quantization error must be measured through
+        the REAL storage path, not a simulation of it)."""
+        import jax.numpy as jnp
+        BT = self.block_tokens
+        MB = self.max_blocks_per_seq
+        # tokens [n, c] int32 (one prompt chunk per row); starts [n]
+        # (absolute position of tokens[i, 0]); lengths [n] (real chunk
+        # length <= c); tables [n, MB] (entry NB = hole: scatter drops
+        # the write, the attention clamps and masks the read).
+        pos = starts[:, None] + jnp.arange(c)[None, :]        # [n, c]
+        in_chunk = jnp.arange(c)[None, :] < lengths[:, None]  # [n, c]
+        x = params["wte"]["embedding"][tokens] \
+            + params["wpe"]["embedding"][
+                jnp.minimum(pos, self.max_len - 1)]
+        pool = dict(cache)
+        wblk = jnp.take_along_axis(
+            tables, jnp.minimum(pos // BT, MB - 1), axis=1)
+        wblk = jnp.where(in_chunk, wblk, NB)  # pad tail: drop writes
+        woff = pos % BT
+        for l in range(self.num_layers):
+            blk = params[f"block_{l}"]
+            q, k, v = self._qkv(x, blk)       # [n, c, H, Dh]
+            if self._kv_quantized:
+                pool = self._quantized_scatter(pool, l, wblk, woff, k, v)
+            else:
+                pool["k"] = pool["k"].at[l, wblk, woff].set(
+                    k.astype(self._kv_store_dtype))
+                pool["v"] = pool["v"].at[l, wblk, woff].set(
+                    v.astype(self._kv_store_dtype))
+            # Query at absolute position p attends to cache positions
+            # <= p — the chunk's own K/V are scattered into the pool
+            # BEFORE the attention, so intra-chunk causality falls out
+            # of the same positional mask as attention over earlier
+            # chunks / cached prefix blocks (both impls).
+            out = self._paged_attend(q, pool, l, tables, starts)
+            x = self._ffn(self._proj(x, out, blk), blk)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return pool, self._logits(last, params)
+
     def _build_prefill_chunk(self, n: int, c: int, NB: int):
         import jax
         import jax.numpy as jnp
-        scale = 1.0 / math.sqrt(self.head_dim)
-        L, BT = self.num_layers, self.block_tokens
-        MB = self.max_blocks_per_seq
-        S = MB * BT
-        H, Dh = self.cfg.num_heads, self.head_dim
 
         def fn(params, cache, tokens, starts, lengths, tables):
-            # tokens [n, c] int32 (one prompt chunk per row); starts [n]
-            # (absolute position of tokens[i, 0]); lengths [n] (real chunk
-            # length <= c); tables [n, MB] (entry NB = hole: scatter drops
-            # the write, gather clamps and the validity mask zeroes it).
-            pos = starts[:, None] + jnp.arange(c)[None, :]        # [n, c]
-            in_chunk = jnp.arange(c)[None, :] < lengths[:, None]  # [n, c]
-            x = params["wte"]["embedding"][tokens] \
-                + params["wpe"]["embedding"][
-                    jnp.minimum(pos, self.max_len - 1)]
-            ck, cv = cache["k"], cache["v"]
-            wblk = jnp.take_along_axis(
-                tables, jnp.minimum(pos // BT, MB - 1), axis=1)
-            wblk = jnp.where(in_chunk, wblk, NB)  # pad tail: drop writes
-            woff = pos % BT
-            # Query at absolute position p attends to cache positions
-            # <= p — the chunk's own K/V are scattered into the pool
-            # BEFORE the gather, so intra-chunk causal attention falls
-            # out of the same gather+mask as attention over earlier
-            # chunks / cached prefix blocks.
-            valid = (jnp.arange(S)[None, None, None, :]
-                     <= pos[:, None, :, None])    # [n, 1, c, S]
-            for l in range(L):
-                blk = params[f"block_{l}"]
-                q, k, v = self._qkv(x, blk)       # [n, c, H, Dh]
-                ck = ck.at[l, wblk, woff].set(k)
-                cv = cv.at[l, wblk, woff].set(v)
-                # Gather-based paged attention: one jnp.take over the
-                # block table per layer reassembles each row's logical
-                # context [S, H, Dh] from arbitrary physical blocks.
-                # mode="clip": hole entries (the OOB sentinel NB) clamp to
-                # a real block whose garbage the validity mask zeroes —
-                # the default "fill" mode would inject NaN instead.
-                kk = jnp.take(ck[l], tables, axis=0, mode="clip") \
-                    .reshape(tables.shape[0], S, H, Dh)
-                vv = jnp.take(cv[l], tables, axis=0, mode="clip") \
-                    .reshape(tables.shape[0], S, H, Dh)
-                s = jnp.einsum("nqhe,nkhe->nhqk",
-                               q.astype(jnp.float32),
-                               kk.astype(jnp.float32)) * scale
-                s = jnp.where(valid, s, jnp.float32(-1e30))
-                p = jax.nn.softmax(s, axis=-1)
-                out = jnp.einsum("nhqk,nkhe->nqhe", p,
-                                 vv.astype(jnp.float32)).astype(self._dtype)
-                x = self._ffn(self._proj(x, out, blk), blk)
-            last = jnp.take_along_axis(
-                x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-            )[:, 0]
-            logits = self._logits(last, params)
-            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+            pool, logits = self._chunk_forward(
+                params, cache, tokens, starts, lengths, tables, NB, c)
+            return pool, jnp.argmax(logits, axis=-1)
 
         return jax.jit(fn, donate_argnums=(1,))
+
+    def prompt_logits(self, prompt: Sequence[int]) -> np.ndarray:
+        """Final-position LM logits for ``prompt`` through the full paged
+        pipeline on a throwaway pool — including the configured KV
+        storage quantization and attention impl.  The bench's
+        ``kv_dtype`` arm and the quantized-error-bound tests read their
+        "max logit error" through this, so the number reflects the real
+        serving path."""
+        import jax.numpy as jnp
+        if not 0 < len(prompt) <= self.max_len:
+            raise ValueError(f"prompt length {len(prompt)} outside "
+                             f"(0, {self.max_len}]")
+        MB = self.max_blocks_per_seq
+        need = -(-len(prompt) // self.block_tokens)
+        pool = self._pool_arrays(need)
+        table = np.full((1, MB), need, np.int32)
+        table[0, :need] = np.arange(need)
+        _, logits = self._chunk_forward(
+            self.params, pool,
+            jnp.asarray(np.asarray(prompt, np.int32)[None]),
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray([len(prompt)], jnp.int32),
+            jnp.asarray(table), need, len(prompt))
+        return np.asarray(logits)[0]
 
     def prefill_chunk(self, cache, chunks, starts, tables):
         """One iteration's prompt chunks: ``chunks[i]`` continues sequence
@@ -453,45 +602,37 @@ class TransformerAdapter(ModelAdapter):
     def _build_paged_decode(self, B: int):
         import jax
         import jax.numpy as jnp
-        scale = 1.0 / math.sqrt(self.head_dim)
         L = self.num_layers
         BT, MB = self.block_tokens, self.max_blocks_per_seq
-        S = MB * BT
-        H, Dh = self.cfg.num_heads, self.head_dim
 
         def fn(params, cache, tokens, positions, tables):
             # tokens [B]; positions [B] (cache index this token's K/V
             # lands at); tables [B, MB] block tables (entry NB for holes
-            # and inactive rows — scatter drops, gather clamps + mask).
+            # and inactive rows — scatter drops, the attention clamps +
+            # masks; NB is baked per pool geometry via the compile key).
             pos = jnp.minimum(positions, self.max_len - 1)
             x = params["wte"]["embedding"][tokens] \
                 + params["wpe"]["embedding"][pos]  # [B, d]
-            ck, cv = cache["k"], cache["v"]
+            pool = dict(cache)
             wblk = jnp.take_along_axis(
                 tables, jnp.minimum(pos // BT, MB - 1)[:, None],
                 axis=1)[:, 0]                             # [B]
             woff = pos % BT
-            s_idx = jnp.arange(S)[None, None, :]          # [1, 1, S]
-            valid = s_idx <= pos[:, None, None]           # [B, 1, S]
             for l in range(L):
                 blk = params[f"block_{l}"]
                 q, k, v = self._qkv(x, blk)               # [B, H, Dh]
-                ck = ck.at[l, wblk, woff].set(k)
-                cv = cv.at[l, wblk, woff].set(v)
-                kk = jnp.take(ck[l], tables, axis=0,
-                              mode="clip").reshape(B, S, H, Dh)
-                vv = jnp.take(cv[l], tables, axis=0,
-                              mode="clip").reshape(B, S, H, Dh)
-                s = jnp.einsum("bhe,bshe->bhs",
-                               q.astype(jnp.float32),
-                               kk.astype(jnp.float32)) * scale
-                s = jnp.where(valid, s, jnp.float32(-1e30))
-                p = jax.nn.softmax(s, axis=-1)
-                out = jnp.einsum("bhs,bshe->bhe", p,
-                                 vv.astype(jnp.float32)).astype(self._dtype)
+                if self._kv_quantized:
+                    pool = self._quantized_scatter(pool, l, wblk, woff,
+                                                   k, v)
+                else:
+                    pool["k"] = pool["k"].at[l, wblk, woff].set(
+                        k.astype(self._kv_store_dtype))
+                    pool["v"] = pool["v"].at[l, wblk, woff].set(
+                        v.astype(self._kv_store_dtype))
+                out = self._paged_attend(q, pool, l, tables, pos)
                 x = self._ffn(self._proj(x, out, blk), blk)
             logits = self._logits(x, params)
-            return {"k": ck, "v": cv}, jnp.argmax(logits, axis=-1)
+            return pool, jnp.argmax(logits, axis=-1)
 
         return jax.jit(fn, donate_argnums=(1,))
 
@@ -656,6 +797,18 @@ class InferenceEngine:
                 f"{type(adapter).__name__} has no paged interface "
                 f"(prefill_chunk/decode_paged); use kv_mode='slot'")
         self.kv_mode = mode
+        # Per-replica observability of HOW attention runs (gather vs the
+        # Pallas kernel) and how KV is stored — surfaced through
+        # kv_stats()/replica.to_dict()/metrics exposition.  Slot mode
+        # ignores both adapter knobs (dense attention over the
+        # compute-dtype slot cache), so it reports what it actually
+        # runs, not what the adapter was configured with.
+        if mode == "paged":
+            self.attn_impl = getattr(adapter, "attn_impl", "gather")
+            self.kv_dtype = getattr(adapter, "kv_dtype", "native")
+        else:
+            self.attn_impl = "dense"
+            self.kv_dtype = "native"
         self.blocks: Optional[BlockManager] = None
         if mode == "paged":
             self._mb = int(getattr(adapter, "max_blocks_per_seq", 0))
@@ -670,7 +823,11 @@ class InferenceEngine:
             pc = (prefix_cache if prefix_cache is not None
                   else os.environ.get("HVD_SERVE_PREFIX_CACHE", "1")
                   not in ("0", "false"))
-            self.blocks = BlockManager(nb, bt, prefix_cache=pc)
+            bpb_fn = getattr(adapter, "paged_block_bytes", None)
+            self.blocks = BlockManager(
+                nb, bt, prefix_cache=pc,
+                bytes_per_block=int(bpb_fn()) if callable(bpb_fn)
+                else None)
             chunk = (prefill_chunk if prefill_chunk is not None
                      else int(os.environ.get("HVD_SERVE_PREFILL_CHUNK",
                                              "64")))
@@ -705,8 +862,15 @@ class InferenceEngine:
 
     def kv_stats(self) -> Optional[dict]:
         """Block-pool utilization / prefix-cache statistics (None in slot
-        mode) — sampled by metrics render and replica healthz."""
-        return self.blocks.stats() if self.blocks is not None else None
+        mode) — sampled by metrics render and replica healthz.  Carries
+        the engine's attention impl + KV storage dtype so both are
+        visible per replica on every export surface."""
+        if self.blocks is None:
+            return None
+        stats = self.blocks.stats()
+        stats["attn_impl"] = self.attn_impl
+        stats["kv_dtype"] = self.kv_dtype
+        return stats
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1227,7 +1391,8 @@ class InferenceEngine:
                 "rebuilding pool and prefix registry", self.replica_id)
             self.blocks = BlockManager(
                 self.blocks.capacity, self.blocks.block_tokens,
-                prefix_cache=self.blocks.prefix_cache_enabled)
+                prefix_cache=self.blocks.prefix_cache_enabled,
+                bytes_per_block=self.blocks.bytes_per_block)
             self._cache = self.adapter.init_paged_cache(
                 self.blocks.capacity, self.max_batch)
         self._step_anchor = None

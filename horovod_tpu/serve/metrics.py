@@ -318,6 +318,30 @@ class ServeMetrics:
                 lines.append(
                     f'hvd_serve_prefix_cache_hit_rate{{replica="{rid}"}} '
                     f'{s.get("prefix_hit_rate", 0.0):g}')
+            # KV storage density + attention implementation per replica
+            # (docs/serving.md paged-kernel section): bytes-per-token is
+            # the quantized-KV win in one number; the impl/dtype info
+            # gauges (constant 1, identity in the labels — Prometheus
+            # *_info convention) make a fleet's gather-vs-kernel and
+            # bf16-vs-int8 mix visible at a glance.
+            lines.append("# TYPE hvd_serve_kv_bytes_per_token gauge")
+            for rid, s in sorted(kv.items()):
+                if "kv_bytes_per_token" in s:
+                    lines.append(
+                        f'hvd_serve_kv_bytes_per_token{{replica="{rid}"}} '
+                        f'{s["kv_bytes_per_token"]:g}')
+            lines.append("# TYPE hvd_serve_attention_impl gauge")
+            for rid, s in sorted(kv.items()):
+                if "attn_impl" in s:
+                    lines.append(
+                        f'hvd_serve_attention_impl{{replica="{rid}",'
+                        f'impl="{s["attn_impl"]}"}} 1')
+            lines.append("# TYPE hvd_serve_kv_dtype gauge")
+            for rid, s in sorted(kv.items()):
+                if "kv_dtype" in s:
+                    lines.append(
+                        f'hvd_serve_kv_dtype{{replica="{rid}",'
+                        f'dtype="{s["kv_dtype"]}"}} 1')
             elapsed = max(time.monotonic() - self.started_at, 1e-9)
             lines.append("# TYPE hvd_serve_tokens_per_sec gauge")
             lines.append(

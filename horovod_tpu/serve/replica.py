@@ -78,11 +78,15 @@ class Replica:
                "ranks": self.ranks, "load": self.load(),
                "active": self.engine.active_count,
                "queued": self.engine.batcher.depth(),
-               "kv_mode": self.engine.kv_mode}
+               "kv_mode": self.engine.kv_mode,
+               "attn_impl": self.engine.attn_impl,
+               "kv_dtype": self.engine.kv_dtype}
         kv = self.engine.kv_stats()
         if kv is not None:
             out["kv_blocks"] = {k: kv[k] for k in
                                 ("total", "used", "free", "retained")}
+            if "bytes_per_block" in kv:
+                out["kv_blocks"]["bytes_per_block"] = kv["bytes_per_block"]
         return out
 
 

@@ -51,6 +51,7 @@ def _bench_env(tag, **overrides):
                 "BENCH_SERVE_REPLICAS", "BENCH_SERVE_SLOT_BATCH",
                 "HVD_SERVE_BLOCK_TOKENS", "HVD_SERVE_PREFILL_CHUNK",
                 "HVD_SERVE_PREFIX_CACHE", "HVD_SERVE_KV_MODE",
+                "HVD_SERVE_ATTN_IMPL", "HVD_SERVE_KV_DTYPE",
                 "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
@@ -199,6 +200,32 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         for key in ("enabled", "hit_rate", "hit_tokens", "cow_copies"):
             assert key in prefix, f"prefix.{key} missing: {prefix}"
         assert prefix["hit_rate"] > 0  # shared-prefix storm really hit
+        # ISSUE 8: attention impl + KV storage dtype are visible in the
+        # record, and the two new arms carry their keys with in-band
+        # exactness.  The kernel arm runs under the Pallas interpreter
+        # on CPU (recorded), so the hermetic bench keeps tracking the
+        # kernel's trend while on-chip capture is unavailable.
+        assert last["attn_impl"] in ("gather", "kernel")
+        assert last["kv_dtype"] == "native"
+        kernel = last["kernel"]
+        for key in ("interpret", "outputs_match", "tokens_per_sec",
+                    "gather_tokens_per_sec", "token_step_p50_ms",
+                    "token_step_p99_ms", "gather_token_step_p50_ms",
+                    "gather_token_step_p99_ms"):
+            assert key in kernel, f"kernel.{key} missing: {kernel}"
+        assert kernel["outputs_match"] is True  # kernel == gather, exact
+        assert kernel["interpret"] is True      # CPU-hermetic run
+        kvarm = last["kv_dtype_arm"]
+        for key in ("budget_bytes", "bytes_per_block_bf16",
+                    "bytes_per_block_int8", "admit_ratio",
+                    "max_logit_err", "outputs_match"):
+            assert key in kvarm, f"kv_dtype_arm.{key} missing: {kvarm}"
+        # The fixed-HBM-budget acceptance bar: int8 blocks admit >= 1.8x
+        # the concurrent sequences bf16 blocks do, exactness (batched ==
+        # single within the int8 engine) intact, logit error bounded.
+        assert kvarm["admit_ratio"] >= 1.8
+        assert kvarm["outputs_match"] is True
+        assert 0 <= kvarm["max_logit_err"] < 0.5
         # ISSUE 6: the fault arm — the bench trajectory records
         # robustness (recovery time + goodput under a seeded plan), not
         # just throughput.

@@ -45,7 +45,7 @@ def test_lint_covers_the_whole_tree():
     serve_files = [f for f in files
                    if os.sep + os.path.join("serve", "") in f]
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
-                "server.py", "metrics.py"):
+                "server.py", "metrics.py", "paged_attention.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under
