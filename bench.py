@@ -53,7 +53,9 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_ATTN_IMPL": "auto",
                  "HVD_SERVE_KV_DTYPE": "native",
                  "HVD_FAULTLINE_SEED": "0",
-                 "HVD_FAULTLINE_PLAN": ""}
+                 "HVD_FAULTLINE_PLAN": "",
+                 "HVD_TRACE_SAMPLE": "0",
+                 "HVD_TRACE_DIR": ""}
 
 
 def _last_good_path():
@@ -342,7 +344,12 @@ def bench_serve():
       admit_ratio of concurrent sequences, max final-logit error vs the
       bf16 engine, and batched==single exactness WITHIN the int8 engine
       (quantization changes logits, so the int8 engine's own
-      single-request run is its reference)."""
+      single-request run is its reference);
+    * ``trace``    — request-tracing overhead (ISSUE 9): the identical
+      storm with the hvdtrace tracer absent (sample=0, the zero-
+      overhead contract — acceptance: ≤2% tokens/s regression, tracked
+      against the record's main trajectory) vs installed at sample=1
+      with shard files written, with in-band exactness."""
     import threading
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
@@ -770,6 +777,59 @@ def bench_serve():
         "outputs_match": fault_outs == outs,
     }
 
+    # -- arm 5: trace-sampling overhead (ISSUE 9) -----------------------------
+    # Identical storm with the tracer ABSENT (sample=0 — the zero-
+    # overhead contract's fast path: every instrumented site is one
+    # module-attribute/None read, so this number tracks the record's
+    # main tokens/s trajectory; acceptance is ≤2% regression there) vs
+    # INSTALLED at sample=1.0 with shard files on disk (every request
+    # spanned end-to-end: queue-wait/prefill/decode/flow per token).
+    # The sampled number prices full tracing, not the production
+    # configuration — production samples a few percent.
+    import shutil
+    import tempfile
+    from horovod_tpu.obs import tracing as _tr
+    tr_prompts = mixed_prompts[:8 if smoke else 16]
+    tr_tokens = min(new_tokens, 8)
+    tr_adapter = TransformerAdapter(cfg, params,
+                                    block_tokens=block_tokens)
+
+    def trace_storm():
+        tsched = build_replicas(lambda: tr_adapter, num_replicas=1,
+                                metrics=ServeMetrics())
+        tsched.start()
+        reqs = [Request(p, max_new_tokens=tr_tokens) for p in tr_prompts]
+        t0 = time.perf_counter()
+        for r in reqs:
+            tsched.submit(r)
+        outs_ = [r.result(timeout=600) for r in reqs]
+        dt_ = time.perf_counter() - t0
+        tsched.stop()
+        return outs_, dt_
+
+    trace_storm()  # warm this config's compile buckets
+    off_outs, off_dt = trace_storm()
+    trace_dir = tempfile.mkdtemp(prefix="hvdtrace-bench-")
+    tracer = _tr.install(_tr.Tracer(sample=1.0, shard_dir=trace_dir))
+    on_outs, on_dt = trace_storm()
+    spans = tracer.spans_emitted
+    # Count shards only AFTER uninstall(): shard files are created
+    # lazily by the tracer's writer thread, which uninstall joins.
+    _tr.uninstall()
+    shard_count = len([f for f in os.listdir(trace_dir)
+                       if f.startswith("trace-")])
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    off_tps = sum(len(o) for o in off_outs) / off_dt
+    on_tps = sum(len(o) for o in on_outs) / on_dt
+    arm_trace = {
+        "sample0_tokens_per_sec": round(off_tps, 2),
+        "sample1_tokens_per_sec": round(on_tps, 2),
+        "sampled_throughput_ratio": round(on_tps / max(off_tps, 1e-9), 4),
+        "outputs_match": on_outs == off_outs,
+        "spans": int(spans),
+        "shards": shard_count,
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -801,6 +861,7 @@ def bench_serve():
         "kernel": arm_kernel,
         "kv_dtype_arm": arm_kv_dtype,
         "faults": arm_faults,
+        "trace": arm_trace,
     })
 
 

@@ -64,6 +64,19 @@ DEFAULT_POINT = {
 HORIZON = 16
 
 
+def _active_trace_id():
+    """The request trace active on the FAULTING thread, if any
+    (obs/tracing.py contextvar): a fault firing inside a traced request
+    scope — a dropped KV response under a traced /generate handler, a
+    kill-rank at a traced routing decision — records WHICH request it
+    hit, so a chaos run's trace correlates faults with victims."""
+    try:
+        from ..obs import tracing as _tr
+        return _tr.current_trace_id()
+    except Exception:
+        return None
+
+
 class FaultInjected(Exception):
     """Raised by an injection point acting out ``poison-step`` (and the
     error in-flight requests observe).  A distinct type so tests and
@@ -234,7 +247,8 @@ class FaultPlan:
                     fired.append(s)
                     self.log.append({
                         "point": point, "instance": instance or "",
-                        "step": idx, "kind": s.kind, "target": s.target})
+                        "step": idx, "kind": s.kind, "target": s.target,
+                        "trace_id": _active_trace_id()})
             events = list(self.log[-len(fired):]) if fired else []
         for ev in events:
             self._emit(ev)
@@ -256,13 +270,14 @@ class FaultPlan:
     def _emit(self, ev: dict) -> None:
         from ..utils import get_logger
         get_logger().warning(
-            "faultline: %s fired at %s[%s] step %d", ev["kind"],
-            ev["point"], ev["instance"], ev["step"])
+            "faultline: %s fired at %s[%s] step %d%s", ev["kind"],
+            ev["point"], ev["instance"], ev["step"],
+            f" trace_id={ev['trace_id']}" if ev.get("trace_id") else "")
         tl = self._timeline
         if tl is None:
             return
         try:
             tl.fault_event(ev["kind"], ev["point"], ev["instance"],
-                           ev["step"])
+                           ev["step"], trace_id=ev.get("trace_id"))
         except Exception:
             pass  # telemetry must never amplify the injected fault

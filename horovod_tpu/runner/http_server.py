@@ -427,6 +427,8 @@ class KVStoreClient:
             os.environ.get("HVD_KV_RETRY_CAP_MS", "2000")) / 1e3
         from ..faultline import runtime as _flrt
         _flrt.maybe_install_from_env()
+        from ..obs import tracing as _tr
+        _tr.maybe_install_from_env()
 
     def _retry_backoff_s(self, attempt: int) -> float:
         """Delay before retry ``attempt`` (1-based): capped exponential
@@ -474,13 +476,30 @@ class KVStoreClient:
         import time as _time
 
         from ..faultline import runtime as _flrt
+        from ..obs import tracing as _tr
+        trace_ctx = None
+        trace_extra = ""
+        if _tr.TRACER is not None:
+            # Wire propagation (docs/observability.md): a KV round-trip
+            # issued while a traced request is active on this thread
+            # carries the trace headers, and each RETRY attempt becomes
+            # a kv-retry span — transport flakes show up inside the
+            # request's own span tree.  One module-attribute read when
+            # tracing is off.
+            trace_ctx = _tr.current()
+            if trace_ctx is not None:
+                trace_extra = (
+                    f"X-Trace-Id: {trace_ctx.trace_id}\r\n"
+                    f"X-Parent-Span: {trace_ctx.span_id}\r\n")
         req = (f"{method} {path} HTTP/1.1\r\nHost: {self.addr}\r\n"
+               f"{trace_extra}"
                f"Content-Length: {len(body) if body else 0}\r\n\r\n"
                .encode("ascii"))
         if body:
             req += body
         for attempt in range(self.retry_max):
             sock = None
+            attempt_t0 = _time.monotonic()
             try:
                 if _flrt.PLAN is not None:
                     # ``kv.request`` injection point (one consult per
@@ -499,6 +518,17 @@ class KVStoreClient:
                 sock.sendall(req)
                 return self._read_response(sock)
             except (ConnectionError, OSError) as e:
+                if trace_ctx is not None and _tr.TRACER is not None:
+                    try:
+                        _tr.TRACER.emit_span(
+                            trace_ctx, "kv-retry", attempt_t0,
+                            _time.monotonic(), "kv-client",
+                            args={"attempt": attempt + 1,
+                                  "of": self.retry_max,
+                                  "method": method,
+                                  "error": str(e)[:120]})
+                    except Exception:
+                        pass
                 if attempt + 1 >= self.retry_max:
                     # Out of budget.  Drop the desynced socket: a request
                     # went out, so a LATE response may still arrive — a

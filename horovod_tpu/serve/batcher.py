@@ -95,8 +95,45 @@ class Request:
         self.replica_id: Optional[str] = None
         self.requeues = 0
         self.first_token_at: Optional[float] = None
+        # Request tracing (obs/tracing.py): ``trace`` is the sampled
+        # request's TraceContext — it travels ON the request because the
+        # lifecycle crosses threads (HTTP handler → batcher queue →
+        # engine loop) where a contextvar cannot follow.  None (the
+        # default) means untraced; every span-emission site guards on
+        # it.  ``resubmitted_at`` marks a failover/preemption requeue so
+        # the NEXT admission can emit the resubmission span
+        # retroactively.
+        self.trace = None
+        self.resubmitted_at: Optional[float] = None
+        self._emit_root = False  # scheduler-sampled (no HTTP root span)
+        # True once an ingress point ROLLED the sampling decision (even
+        # if the answer was "don't trace"): the scheduler's fallback
+        # sampling must not re-roll a request the HTTP front-end already
+        # decided against — that would double the effective sample rate
+        # and trace requests whose responses carry no X-Trace-Id.
+        self._sampling_decided = False
+        # Per-stage latency decomposition (docs/observability.md): an
+        # EXACT partition of [submitted_at, completion] into queue /
+        # prefill / decode / retry milliseconds, advanced by stage_add
+        # at each lifecycle boundary — the engine feeds the totals into
+        # the hvd_serve_stage_ms histograms at completion (the
+        # per-stage inputs ROADMAP item 4's autoscaler consumes).
+        # Always on: the cost is one clock read per boundary.
+        self.stage_ms: Dict[str, float] = {"queue": 0.0, "prefill": 0.0,
+                                           "decode": 0.0, "retry": 0.0}
+        self._stage_mark = self.submitted_at
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+
+    def stage_add(self, stage: str, now: Optional[float] = None) -> float:
+        """Credit the time since the last boundary to ``stage`` and
+        advance the mark; returns the previous mark (span emitters use
+        it as the retroactive span's start)."""
+        now = time.monotonic() if now is None else now
+        prev = self._stage_mark
+        self.stage_ms[stage] += max(now - prev, 0.0) * 1e3
+        self._stage_mark = now
+        return prev
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None

@@ -62,6 +62,7 @@ import numpy as np
 
 from ..faultline import runtime as _faultline
 from ..faultline.plan import FaultInjected
+from ..obs import tracing as _obs
 from ..utils import get_logger
 from .batcher import (DeadlineExceededError, DynamicBatcher, Request,
                       bucket_requests, prompt_bucket)
@@ -839,6 +840,16 @@ class InferenceEngine:
             self._mb = 0
             self._cache = adapter.init_cache(self.max_batch)
         self._slots: List[Optional[object]] = [None] * self.max_batch
+        # Deferred trace emissions (loop-thread only): span/flow
+        # emission does shard-file IO under the tracer's lock, and the
+        # lifecycle boundaries where spans become known sit inside
+        # ``self._lock`` critical sections — emitting there would let a
+        # slow disk stall the decode loop and every thread contending
+        # on the engine lock.  The loop collects closures under the
+        # lock and flushes them after release (_flush_trace_emits);
+        # timestamps are captured at the boundary, so deferral changes
+        # nothing in the artifact.
+        self._trace_emits: List = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -848,6 +859,9 @@ class InferenceEngine:
         # Fault injection (faultline): env-configured plans bootstrap at
         # construction; the per-iteration guard is a None check.
         _faultline.maybe_install_from_env()
+        # Request tracing (obs): same constructor-time env bootstrap and
+        # the same None-check hot-path discipline.
+        _obs.maybe_install_from_env()
 
     # -- introspection -------------------------------------------------------
 
@@ -917,6 +931,7 @@ class InferenceEngine:
         decoding reproduces the output exactly on the new replica, whose
         own prefix cache (if any) re-fills from the prompt."""
         self.stop()
+        now = time.monotonic()
         with self._lock:
             inflight = []
             for i, s in enumerate(self._slots):
@@ -925,6 +940,9 @@ class InferenceEngine:
                         self.blocks.free_table(s.table)
                     s.request.generated = []
                     s.request.requeues += 1
+                    # Failover bookkeeping: the next admission (on the
+                    # survivor) emits the resubmission span from here.
+                    s.request.resubmitted_at = now
                     inflight.append(s.request)
                     self._slots[i] = None
             return inflight
@@ -940,9 +958,95 @@ class InferenceEngine:
         return (len(r.generated) >= r.max_new_tokens
                 or (r.eos_id is not None and token == r.eos_id))
 
+    def _flush_trace_emits(self) -> None:
+        """Run deferred span/flow emissions OUTSIDE the engine lock
+        (loop thread only — every deferring site is)."""
+        if not self._trace_emits:
+            return
+        pending, self._trace_emits = self._trace_emits, []
+        for fn in pending:
+            try:
+                fn()
+            except Exception:
+                pass  # tracing must never take down the decode loop
+
+    def _defer_flow(self, r: Request) -> None:
+        """Queue one token-stream flow step for a traced request —
+        every token-append site defers through here (flushed outside
+        the engine lock)."""
+        if r.trace is None or _obs.TRACER is None:
+            return
+
+        def emit(t=_obs.TRACER, r=r):
+            t.flow(r.trace, "token-stream", self.replica_id)
+        self._trace_emits.append(emit)
+
     def _complete(self, r: Request) -> None:
+        now = time.monotonic()
+        if r.first_token_at is not None:
+            r.stage_add("decode", now)
+        # Stage decomposition feeds /metrics unconditionally (the
+        # autoscaler inputs, docs/observability.md); the SPANS only for
+        # sampled requests.
+        for stage, ms in r.stage_ms.items():
+            if ms > 0.0:
+                self.metrics.observe_stage(stage, ms)
+        if r.trace is not None and _obs.TRACER is not None:
+            t = _obs.TRACER
+
+            def emit(t=t, r=r, now=now, first=r.first_token_at,
+                     ntok=len(r.generated)):
+                if first is not None:
+                    t.emit_span(r.trace, "decode", first, now,
+                                self.replica_id,
+                                args={"tokens": ntok,
+                                      "requeues": r.requeues})
+                t.flow(r.trace, "token-stream", self.replica_id,
+                       end=True)
+                if r._emit_root:
+                    # Scheduler-sampled request (no HTTP front-end —
+                    # bench / direct submit): the root span is the whole
+                    # request, emitted here where completion is known.
+                    t.emit_span(r.trace, "request", r.submitted_at, now,
+                                self.replica_id,
+                                args={"request_id": r.request_id},
+                                root=True)
+            self._trace_emits.append(emit)
         r.complete()
         self.metrics.count_request("ok")
+
+    def _observe_admission(self, requests: Sequence[Request]) -> None:
+        """Per-request admission boundary: credit the wait to queue (or
+        retry after a failover/preemption requeue) and emit the
+        queue-wait / resubmission span for sampled requests."""
+        now = time.monotonic()
+        tracer = _obs.TRACER
+        for r in requests:
+            stage = "retry" if r.requeues else "queue"
+            prev = r.stage_add(stage, now)
+            if r.trace is None or tracer is None:
+                r.resubmitted_at = None
+                continue
+            try:
+                if r.resubmitted_at is not None:
+                    # The failover span the merged fleet trace shows
+                    # crossing replicas: requeue time → this admission,
+                    # attributed to the replica that picked the work up.
+                    tracer.emit_span(
+                        r.trace, "resubmission", r.resubmitted_at, now,
+                        self.replica_id,
+                        args={"to": self.replica_id,
+                              "requeues": r.requeues})
+                    r.resubmitted_at = None
+                else:
+                    tracer.emit_span(
+                        r.trace, "queue-wait", prev, now,
+                        self.replica_id,
+                        args={"replica": self.replica_id})
+                tracer.instant(r.trace, "admission", self.replica_id,
+                               args={"replica": self.replica_id}, t=now)
+            except Exception:
+                pass
 
     def _fail_doomed(self, r: Request) -> bool:
         """Requests that can never run on this engine fail loudly at
@@ -996,11 +1100,20 @@ class InferenceEngine:
                     f"{s.request.request_id} deadline expired mid-flight "
                     f"({len(s.request.generated)} token(s) generated)"))
                 self.metrics.count_request("expired")
+                if s.request.trace is not None \
+                        and _obs.TRACER is not None:
+                    def emit(t=_obs.TRACER, r=s.request, now=now,
+                             ntok=len(s.request.generated)):
+                        t.instant(r.trace, "deadline-expired",
+                                  self.replica_id,
+                                  args={"tokens": ntok}, t=now)
+                    self._trace_emits.append(emit)
                 table = getattr(s, "table", None)
                 if self.blocks is not None and table is not None:
                     self.blocks.free_table(table)
                 self._slots[i] = None
                 expired += 1
+        self._flush_trace_emits()
         return expired
 
     # -- fault injection (faultline) -----------------------------------------
@@ -1037,6 +1150,7 @@ class InferenceEngine:
         admitted = self.batcher.get_admission(len(free), block_s=block_s)
         if not admitted:
             return 0
+        self._observe_admission(admitted)
         cursor = 0
         for p_bucket, group in sorted(
                 bucket_requests(admitted, cap=self.adapter.max_len).items()):
@@ -1056,13 +1170,24 @@ class InferenceEngine:
                     r.replica_id = self.replica_id
                     r.first_token_at = now
                     r.generated.append(int(tok))
+                    r.stage_add("prefill", now)
                     self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
+                    if r.trace is not None and _obs.TRACER is not None:
+                        def emit(t=_obs.TRACER, r=r, t0=t0, now=now,
+                                 p_bucket=p_bucket, n=len(runnable)):
+                            t.emit_span(r.trace, "prefill", t0, now,
+                                        self.replica_id,
+                                        args={"bucket": p_bucket,
+                                              "batch": n})
+                        self._trace_emits.append(emit)
+                        self._defer_flow(r)
                     if self._finished(r, int(tok)):
                         self._complete(r)
                     else:
                         # Cache holds positions 0..P-1; the first decode
                         # feeds the prefill's token at position P.
                         self._slots[slot] = _Slot(r, len(r.prompt))
+            self._flush_trace_emits()
             get_logger().debug(
                 "%s: admitted %d (bucket %d) in %.1f ms", self.replica_id,
                 len(runnable), p_bucket, (now - t0) * 1e3)
@@ -1098,11 +1223,13 @@ class InferenceEngine:
                 tok = int(nxt[i])
                 s.request.generated.append(tok)
                 s.length += 1
+                self._defer_flow(s.request)
                 if self._finished(s.request, tok) \
                         or s.length >= self.adapter.max_len:
                     self._complete(s.request)
                     self._slots[i] = None
         self.steps += 1
+        self._flush_trace_emits()
         self.metrics.observe_decode_step(dt_ms, len(active), len(active))
         self.metrics.maybe_emit_timeline()
         return len(active)
@@ -1133,6 +1260,7 @@ class InferenceEngine:
             hard_cap=self.blocks.capacity if use_blocks else None)
         if not admitted:
             return 0
+        self._observe_admission(admitted)
         cursor = 0
         for idx, r in enumerate(admitted):
             if self._fail_doomed(r):
@@ -1197,9 +1325,26 @@ class InferenceEngine:
                   for _, s, take in sel]
         starts = [s.prompt_pos for _, s, _ in sel]
         tables = [list(s.table) for _, s, _ in sel]
+        t0 = time.monotonic()
         self._cache, first = self.adapter.prefill_chunk(
             self._cache, chunks, starts, tables)
         now = time.monotonic()
+        if _obs.TRACER is not None:
+            # One prefill-chunk span per TRACED sequence in this batched
+            # call (same t0/now — they shared the compute), so a long
+            # prompt's chunk-by-chunk streaming is visible per request.
+            for (_, s, take), start in zip(sel, starts):
+                r = s.request
+                if r.trace is None or take <= 0:
+                    continue
+                try:
+                    _obs.TRACER.emit_span(
+                        r.trace, "prefill-chunk", t0, now,
+                        self.replica_id,
+                        args={"tokens": take, "start": start,
+                              "batched": len(sel)})
+                except Exception:
+                    pass
         total = 0
         bt = self.blocks.block_tokens if self.blocks is not None else 1
         with self._lock:
@@ -1224,12 +1369,15 @@ class InferenceEngine:
                 r = s.request
                 r.first_token_at = now
                 r.generated.append(tok)
+                r.stage_add("prefill", now)
                 self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
+                self._defer_flow(r)
                 if self._finished(r, tok):
                     self._complete(r)
                     if self.blocks is not None:
                         self.blocks.free_table(s.table)
                     self._slots[i] = None
+        self._flush_trace_emits()
         return total
 
     def _preempt(self, slot: int, s: "_Seq") -> None:
@@ -1244,6 +1392,15 @@ class InferenceEngine:
         self.blocks.free_table(s.table)
         s.request.generated = []
         s.request.requeues += 1
+        now = time.monotonic()
+        s.request.resubmitted_at = now
+        if s.request.trace is not None and _obs.TRACER is not None:
+            try:
+                _obs.TRACER.instant(
+                    s.request.trace, "preempted", self.replica_id,
+                    args={"reason": "kv-pool-exhausted"}, t=now)
+            except Exception:
+                pass
         self.metrics.count_request("preempted")
         self.batcher.requeue_front([s.request])
         get_logger().warning(
@@ -1334,6 +1491,7 @@ class InferenceEngine:
                 tok = int(nxt[i])
                 s.request.generated.append(tok)
                 s.length += 1
+                self._defer_flow(s.request)
                 if self._finished(s.request, tok) \
                         or s.length >= self.adapter.max_len:
                     self._complete(s.request)
@@ -1341,6 +1499,7 @@ class InferenceEngine:
                         self.blocks.free_table(s.table)
                     self._slots[i] = None
         self.steps += 1
+        self._flush_trace_emits()
         self.metrics.observe_decode_step(dt_ms, len(active), len(active))
         if self.blocks is not None:
             self.metrics.maybe_emit_timeline(kv_stats=self.blocks.stats())
@@ -1383,6 +1542,7 @@ class InferenceEngine:
                     if self.blocks is not None:
                         self.blocks.free_table(s.table)
                     self._slots[i] = None
+        self._flush_trace_emits()  # leftovers from the crashed helper
         if self.kv_mode == "slot":
             self._cache = self.adapter.init_cache(self.max_batch)
         elif self._cache_deleted():

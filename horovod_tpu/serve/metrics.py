@@ -80,6 +80,14 @@ class ServeMetrics:
         self.started_at = time.monotonic()
         self.ttft_ms = Histogram()
         self.token_step_ms = Histogram()
+        # Per-request stage decomposition (obs tracing, ROADMAP item 4):
+        # queue / prefill / decode / retry milliseconds per COMPLETED
+        # request, an exact partition of its end-to-end latency
+        # (Request.stage_add) — the autoscaler's per-stage inputs beyond
+        # the aggregate TTFT/token-step histograms above.
+        self.stage_ms: Dict[str, Histogram] = {
+            s: Histogram() for s in ("queue", "prefill", "decode",
+                                     "retry")}
         self.tokens_total = 0
         self.decode_steps_total = 0
         self.prefills_total = 0
@@ -147,6 +155,15 @@ class ServeMetrics:
     def count_request(self, outcome: str) -> None:
         with self._lock:
             self.requests[outcome] = self.requests.get(outcome, 0) + 1
+
+    def observe_stage(self, stage: str, ms: float) -> None:
+        """One completed request's time in ``stage`` (queue / prefill /
+        decode / retry) — engine._complete feeds every non-zero stage."""
+        with self._lock:
+            h = self.stage_ms.get(stage)
+            if h is None:
+                h = self.stage_ms[stage] = Histogram()
+            h.observe(ms)
 
     def count_preempt_poll_error(self) -> None:
         with self._lock:
@@ -227,6 +244,8 @@ class ServeMetrics:
                 "queue_depth": depths,
                 "ttft": self.ttft_ms.to_dict(),
                 "token_step": self.token_step_ms.to_dict(),
+                "stage": {s: h.to_dict()
+                          for s, h in self.stage_ms.items()},
                 "token_split": {
                     "prefill_tokens": self.prefill_tokens_total,
                     "decode_tokens": self.decode_tokens_total,
@@ -248,21 +267,36 @@ class ServeMetrics:
         with self._lock:
             lines = []
 
-            def hist(name, h: Histogram, help_):
-                lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} histogram")
-                cum = 0
+            def hist(name, h: Histogram, help_=None, labels=""):
+                # ``labels`` (e.g. 'stage="queue"') prefixes every le
+                # pair and suffixes _sum/_count — one rendering for the
+                # plain and labeled histogram families.
+                if help_ is not None:
+                    lines.append(f"# HELP {name} {help_}")
+                    lines.append(f"# TYPE {name} histogram")
+                sep = labels + "," if labels else ""
+                suffix = "{" + labels + "}" if labels else ""
                 for bound, c in zip(h.bounds, h.counts):
-                    cum = c
-                    lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
-                lines.append(f"{name}_sum {h.sum:g}")
-                lines.append(f"{name}_count {h.count}")
+                    lines.append(
+                        f'{name}_bucket{{{sep}le="{bound:g}"}} {c}')
+                lines.append(f'{name}_bucket{{{sep}le="+Inf"}} {h.count}')
+                lines.append(f"{name}_sum{suffix} {h.sum:g}")
+                lines.append(f"{name}_count{suffix} {h.count}")
 
             hist("hvd_serve_ttft_ms", self.ttft_ms,
                  "Time to first token (prefill wait + compute), ms")
             hist("hvd_serve_token_step_ms", self.token_step_ms,
                  "Decode step duration (per-output-token latency), ms")
+            # Per-stage request-latency decomposition (one histogram per
+            # stage label — the exact partition of each completed
+            # request's end-to-end latency, docs/observability.md).
+            lines.append("# HELP hvd_serve_stage_ms per-request latency "
+                         "by lifecycle stage (queue|prefill|decode|"
+                         "retry), ms")
+            lines.append("# TYPE hvd_serve_stage_ms histogram")
+            for stage in sorted(self.stage_ms):
+                hist("hvd_serve_stage_ms", self.stage_ms[stage],
+                     labels=f'stage="{stage}"')
             lines.append("# TYPE hvd_serve_tokens_total counter")
             lines.append(f"hvd_serve_tokens_total {self.tokens_total}")
             lines.append("# TYPE hvd_serve_decode_steps_total counter")
@@ -342,6 +376,22 @@ class ServeMetrics:
                     lines.append(
                         f'hvd_serve_kv_dtype{{replica="{rid}",'
                         f'dtype="{s["kv_dtype"]}"}} 1')
+            # Timeline writer-queue drop accounting (timeline.py bounded
+            # queue): a truncated trace must be detectable from the
+            # metrics plane too, not only from the trace trailer.
+            if self._timeline is not None:
+                try:
+                    dropped = int(self._timeline.dropped_events)
+                except Exception:
+                    # An unreadable counter is OMITTED, not faked: a -1
+                    # would be an invalid (negative, resetting) value
+                    # for a Prometheus counter series.
+                    dropped = None
+                if dropped is not None:
+                    lines.append("# TYPE hvd_timeline_dropped_events_"
+                                 "total counter")
+                    lines.append(
+                        f"hvd_timeline_dropped_events_total {dropped}")
             elapsed = max(time.monotonic() - self.started_at, 1e-9)
             lines.append("# TYPE hvd_serve_tokens_per_sec gauge")
             lines.append(

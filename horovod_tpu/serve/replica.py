@@ -39,9 +39,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..faultline import runtime as _faultline
+from ..obs import tracing as _obs
 from ..utils import get_logger
 from .batcher import DynamicBatcher, QueueFullError, Request
 from .engine import InferenceEngine, ModelAdapter
@@ -139,6 +141,18 @@ class ReplicaScheduler:
                     self.report_rank_lost(int(f.target))
                 else:
                     self.mark_dead(f.target, reason="faultline kill-rank")
+        if _obs.TRACER is not None and not request._sampling_decided:
+            # Front-end-less ingress (bench storms, direct submits): the
+            # scheduler is the sampling point and the engine emits the
+            # root span at completion (no http-handle exists).  An HTTP
+            # request that already lost the front-end's roll is NOT
+            # re-rolled (_sampling_decided) — re-rolling would double
+            # the effective sample rate and trace requests whose
+            # responses carry no X-Trace-Id.
+            request._sampling_decided = True
+            if _obs.TRACER.should_sample():
+                request.trace = _obs.TRACER.new_context()
+                request._emit_root = True
         candidates = sorted(self._healthy(), key=lambda r: r.load())
         if not candidates:
             self.metrics.count_request("error")
@@ -208,11 +222,27 @@ class ReplicaScheduler:
         # that late submit raises QueueFullError and fails over to the
         # next candidate.  close() returns the queued requests.
         queued = victim.engine.batcher.close()
+        now = time.monotonic()
         for req in queued:
             req.requeues += 1  # engine.drain() bumps its own
+            req.resubmitted_at = now
         orphans = queued + victim.engine.drain()
         if not orphans:
             return
+        if _obs.TRACER is not None:
+            # Failover forensics: each traced orphan gets a resubmit
+            # instant naming the dead replica; the span closing at the
+            # survivor's admission starts from resubmitted_at.
+            for req in orphans:
+                if req.trace is None:
+                    continue
+                try:
+                    _obs.TRACER.instant(
+                        req.trace, "resubmit", replica_id,
+                        args={"from": replica_id,
+                              "reason": reason or "mark_dead"})
+                except Exception:
+                    pass
         # Already-accepted work must NOT shed on a replica loss: it goes
         # to the FRONT of the survivors' queues past the capacity bound
         # (requeue_front's contract), dealt round-robin starting at the

@@ -40,9 +40,11 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs import tracing as _obs
 from ..utils import get_logger
 from .batcher import DeadlineExceededError, QueueFullError, Request
 from .metrics import ServeMetrics
@@ -53,6 +55,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     disable_nagle_algorithm = True  # see module doc / runner KV server
 
+    #: The active request's trace context (obs/tracing.py), set per
+    #: do_POST; every reply — 200 AND the 400/503/504 sheds — echoes its
+    #: trace id so a client-side retry can be correlated with the
+    #: server-side shed it answered (chaos-soak forensics).
+    _trace_ctx = None
+    _trace_echo = None  # inbound X-Trace-Id when untraced: still echoed
+
     def log_message(self, fmt, *args):
         get_logger().debug("serve: " + fmt % args)
 
@@ -62,6 +71,12 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        tid = (self._trace_ctx.trace_id if self._trace_ctx is not None
+               else self._trace_echo)
+        if tid is not None:
+            self.send_header("X-Trace-Id", tid)
+            if self._trace_ctx is not None:
+                self.send_header("X-Span-Id", self._trace_ctx.span_id)
         for k, v in extra_headers:
             self.send_header(k, v)
         self.end_headers()
@@ -87,7 +102,25 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------------
 
+    @staticmethod
+    def _safe_id(value):
+        """Inbound trace/span ids are client input that gets echoed into
+        response headers and forwarded onto KV requests: restrict to a
+        sane id alphabet (no CRLF header injection, no non-ascii
+        breaking the hand-rolled KV writer); anything else is treated as
+        absent."""
+        if value and len(value) <= 128 and \
+                all(c.isascii() and (c.isalnum() or c in "-_.")
+                    for c in value):
+            return value
+        return None
+
     def do_GET(self):
+        # Keep-alive reuses one handler instance across requests: the
+        # per-request trace state must reset or a prior POST's id would
+        # echo on this response.
+        self._trace_ctx = None
+        self._trace_echo = self._safe_id(self.headers.get("X-Trace-Id"))
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             health = self.server.scheduler.healthz()
@@ -96,13 +129,64 @@ class _ServeHandler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             self._reply(200, self.server.metrics.render().encode(),
                         content_type="text/plain; version=0.0.4")
+        elif path == "/trace":
+            # Sampled request span trees, newest first (obs/tracing.py
+            # recent buffer) — the quick-look surface when a full
+            # hvdtrace shard merge is overkill.
+            tracer = _obs.TRACER
+            self._reply_json(200, {
+                "enabled": tracer is not None,
+                "sample": tracer.sample if tracer is not None else 0.0,
+                "traces": (tracer.recent_traces()
+                           if tracer is not None else []),
+            })
         else:
             self._reply_json(404, {"error": f"unknown path {path}"})
 
     def do_POST(self):
+        # Trace ingress (docs/observability.md): an inbound X-Trace-Id
+        # continues the upstream hop's trace (it made the sampling
+        # decision); otherwise HVD_TRACE_SAMPLE decides.  The context
+        # rides a contextvar for THIS thread's work (route, KV calls)
+        # and travels on the Request object into the engine.  Untraced
+        # requests still echo any inbound X-Trace-Id (_reply).
+        tracer = _obs.TRACER
+        hdr_tid = self._safe_id(self.headers.get("X-Trace-Id"))
+        self._trace_echo = hdr_tid
+        ctx = None
+        if tracer is not None and (hdr_tid is not None
+                                   or tracer.should_sample()):
+            ctx = tracer.new_context(
+                trace_id=hdr_tid,
+                parent=self._safe_id(self.headers.get("X-Parent-Span")))
+        self._trace_ctx = ctx
+        if ctx is None:
+            self._handle_generate(None)
+            return
+        t0 = time.monotonic()
+        token = _obs.push(ctx)
+        # Default outcome when _handle_generate raises before replying
+        # (e.g. a BrokenPipeError writing to a disconnected client):
+        # the root span must still be emitted or exactly the
+        # failure-path requests lose their http-handle root.
+        status = 500
+        try:
+            status = self._handle_generate(ctx)
+        finally:
+            _obs.pop(token)
+            try:
+                tracer.emit_span(
+                    ctx, "http-handle", t0, time.monotonic(), "server",
+                    args={"status": status}, root=True)
+            except Exception:
+                pass  # tracing must never take down the HTTP plane
+
+    def _handle_generate(self, ctx) -> int:
+        """The /generate body; returns the HTTP status it answered (the
+        root span's outcome arg)."""
         if self.path.split("?", 1)[0] != "/generate":
             self._reply_json(404, {"error": "POST /generate only"})
-            return
+            return 404
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -124,22 +208,39 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 timeout_s=timeout_s,
                 request_id=payload.get("request_id"))
         except (KeyError, TypeError, ValueError) as e:
+            self._shed_log("bad_request", None, e)
             self._reply_json(400, {"error": str(e)})
-            return
+            return 400
+        # Before submit: admission may be instant.  The front-end OWNS
+        # the sampling decision — ctx None here means "rolled and lost"
+        # (or tracer off), and the scheduler must not re-roll it.
+        request.trace = ctx
+        request._sampling_decided = True
         try:
-            self.server.scheduler.submit(request)
+            t_route = time.monotonic()
+            replica = self.server.scheduler.submit(request)
+            if ctx is not None and _obs.TRACER is not None:
+                try:
+                    _obs.TRACER.emit_span(
+                        ctx, "route", t_route, time.monotonic(), "server",
+                        args={"replica": replica.replica_id})
+                except Exception:
+                    pass
             tokens = request.result(timeout=self.server.request_timeout_s)
         except (QueueFullError, NoHealthyReplicaError) as e:
+            self._shed_log("shed", request, e)
             self._reply_json(503, {"error": str(e)},
                              extra_headers=self._budget_headers(request))
-            return
+            return 503
         except (DeadlineExceededError, TimeoutError) as e:
+            self._shed_log("expired", request, e)
             self._reply_json(504, {"error": str(e)},
                              extra_headers=self._budget_headers(request))
-            return
+            return 504
         except Exception as e:  # engine-side failure — surfaced, not hung
+            self._shed_log("error", request, e)
             self._reply_json(500, {"error": str(e)})
-            return
+            return 500
         ttft_ms = None
         if request.first_token_at is not None:
             ttft_ms = round(
@@ -151,6 +252,17 @@ class _ServeHandler(BaseHTTPRequestHandler):
             "requeues": request.requeues,
             "ttft_ms": ttft_ms,
         })
+        return 200
+
+    def _shed_log(self, outcome: str, request, exc) -> None:
+        """Shed/error forensics line carrying the trace id, so a
+        client-side retry observed in a chaos soak correlates with the
+        server-side shed that caused it."""
+        tid = (self._trace_ctx.trace_id if self._trace_ctx is not None
+               else self._trace_echo)
+        get_logger().debug(
+            "serve: outcome=%s request=%s trace_id=%s (%s)", outcome,
+            getattr(request, "request_id", "-"), tid or "-", exc)
 
 
 class ServeServer:
@@ -166,6 +278,10 @@ class ServeServer:
             else float(os.environ.get("HVD_SERVE_REQUEST_TIMEOUT_S", "120")))
         self.httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Request tracing: env bootstrap at the front door, so an
+        # HVD_TRACE_SAMPLE'd hvdserve needs no code changes (engine
+        # constructors bootstrap too — whichever comes up first wins).
+        _obs.maybe_install_from_env()
 
     def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
         self.scheduler.start()

@@ -73,16 +73,60 @@ WITNESS = "WITNESS"
 # lifecycle.
 COLLECTIVE_CENSUS = "COLLECTIVE_CENSUS"
 
+# Distributed request tracing (obs/tracing.py, docs/observability.md):
+# per-request spans render as Chrome ASYNC events ("b"/"e") keyed by the
+# request's trace_id, so one /generate call's http-handle → route →
+# queue-wait → prefill → decode lifecycle nests in its own lane next to
+# the training-op lifecycle, FAULTLINE instants, and SERVE counters.
+# Per-decode-iteration progress renders as FLOW events ("s"/"t"/"f")
+# under the same id — Perfetto draws the token stream as arrows through
+# the request's spans.
+HVDTRACE = "hvdtrace"
+HVDTRACE_FLOW = "hvdtrace-flow"
+
+
+def force_put_sentinel(q: "queue.Queue", on_drop) -> None:
+    """Deliver a ``None`` shutdown sentinel to a bounded queue WITHOUT
+    blocking: the producer side must already be closed (no new puts),
+    so if the queue is full, discard queued items — accounting each via
+    ``on_drop()``, they will never be written — until the sentinel
+    fits.  Shared by the Timeline and Tracer writer shutdown paths: a
+    silently-lost sentinel leaves a healthy writer parked in ``get()``
+    forever."""
+    while True:
+        try:
+            q.put_nowait(None)
+            return
+        except queue.Full:
+            try:
+                q.get_nowait()
+                on_drop()
+            except queue.Empty:
+                continue
+
 
 class Timeline:
     """Chrome-trace writer with a background writer thread
     (TimelineWriter, timeline.h:48)."""
 
-    def __init__(self, path: str, mark_cycles: bool = False, rank: int = 0):
+    def __init__(self, path: str, mark_cycles: bool = False, rank: int = 0,
+                 queue_cap: Optional[int] = None):
         self.path = path
         self.mark_cycles = mark_cycles
         self.rank = rank
-        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=1 << 16)
+        # BOUNDED event queue (HVD_TIMELINE_QUEUE_CAP): a stalled writer
+        # thread (wedged disk, dead NFS mount) must cost bounded memory —
+        # the hot path drops events past the cap rather than queueing
+        # unbounded, and every drop is COUNTED so a truncated trace is
+        # never mistaken for a complete one (the total surfaces as a
+        # counter event at close and as
+        # ``hvd_timeline_dropped_events_total`` on serve /metrics).
+        cap = queue_cap if queue_cap is not None else int(
+            os.environ.get("HVD_TIMELINE_QUEUE_CAP", str(1 << 16)))
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=max(cap, 2))
+        self._dropped = 0
+        self._drop_lock = threading.Lock()
         self._start = time.monotonic_ns()
         self._closed = False
         self._fh = open(path, "w")
@@ -98,13 +142,29 @@ class Timeline:
     def _ts_us(self) -> float:
         return (time.monotonic_ns() - self._start) / 1e3
 
+    def ts_of(self, mono_ns: int) -> float:
+        """Map a caller-captured ``time.monotonic_ns()`` stamp onto this
+        timeline's microsecond axis (retroactive span emission: the
+        tracer records span boundaries where they happen and emits the
+        whole span at its end)."""
+        return (mono_ns - self._start) / 1e3
+
+    @property
+    def dropped_events(self) -> int:
+        """Events dropped at the bounded queue so far (module doc)."""
+        with self._drop_lock:
+            return self._dropped
+
     def _put(self, ev: dict) -> None:
         if self._closed:
             return
         try:
             self._queue.put_nowait(ev)
         except queue.Full:
-            pass  # drop rather than stall the hot path (reference SPSC behavior)
+            # Drop rather than stall the hot path (reference SPSC
+            # behavior) — but ACCOUNT the drop (class doc).
+            with self._drop_lock:
+                self._dropped += 1
 
     def _emit_meta(self):
         self._put({"name": "process_name", "ph": "M", "pid": self.rank,
@@ -193,13 +253,18 @@ class Timeline:
                             for k, v in values.items()}})
 
     def fault_event(self, kind: str, point: str, instance: str,
-                    step: int):
+                    step: int, trace_id: Optional[str] = None):
         """One fault firing (faultline): process-scoped instant event
-        carrying the injection point, instance, and step index."""
+        carrying the injection point, instance, and step index — plus
+        the request trace_id when the fault fired inside a traced
+        request scope (obs/tracing.py), so a chaos run's trace shows
+        WHICH request each fault hit."""
+        args = {"point": point, "instance": instance, "step": int(step)}
+        if trace_id is not None:
+            args["trace_id"] = trace_id
         self._put({"name": f"{FAULTLINE}/{kind}", "ph": "i", "s": "p",
                    "ts": self._ts_us(), "pid": self.rank, "tid": point,
-                   "args": {"point": point, "instance": instance,
-                            "step": int(step)}})
+                   "args": args})
 
     def witness_event(self, rule: str, site_path: str, site_line: int,
                       thread_name: str):
@@ -211,6 +276,42 @@ class Timeline:
                    "tid": thread_name,
                    "args": {"site": f"{site_path}:{int(site_line)}",
                             "thread": thread_name}})
+
+    def trace_span(self, trace_id: str, name: str, tid: str,
+                   start_mono_ns: int, dur_us: float,
+                   args: Optional[dict] = None):
+        """One request-trace span (obs/tracing.py): Chrome ASYNC begin/end
+        pair keyed by the request's trace_id, so every span of one
+        request nests in one lane across components.  ``start_mono_ns``
+        is a caller-captured ``time.monotonic_ns()`` stamp (spans are
+        emitted retroactively at their end)."""
+        ts = self.ts_of(start_mono_ns)
+        base = {"cat": HVDTRACE, "id": trace_id, "name": name,
+                "pid": self.rank, "tid": tid}
+        self._put(dict(base, ph="b", ts=ts, args=args or {}))
+        self._put(dict(base, ph="e", ts=ts + max(dur_us, 0.0)))
+
+    def trace_flow(self, trace_id: str, name: str, tid: str, phase: str,
+                   mono_ns: Optional[int] = None):
+        """One request-trace flow event (``phase`` in s/t/f): the
+        per-decode-iteration token stream renders as arrows through the
+        request's spans in Perfetto."""
+        ts = self.ts_of(mono_ns) if mono_ns is not None else self._ts_us()
+        ev = {"cat": HVDTRACE_FLOW, "id": trace_id, "name": name,
+              "ph": phase, "ts": ts, "pid": self.rank, "tid": tid}
+        if phase == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice's end
+        self._put(ev)
+
+    def trace_instant(self, trace_id: str, name: str, tid: str,
+                      args: Optional[dict] = None,
+                      mono_ns: Optional[int] = None):
+        """Request-scoped instant event (deadline expiry, resubmission,
+        preemption) carrying the trace_id in its args."""
+        ts = self.ts_of(mono_ns) if mono_ns is not None else self._ts_us()
+        self._put({"name": f"{HVDTRACE}/{name}", "ph": "i", "s": "p",
+                   "ts": ts, "pid": self.rank, "tid": tid,
+                   "args": dict(args or {}, trace_id=trace_id)})
 
     def mark_cycle(self):
         """Optional cycle marker (HOROVOD_TIMELINE_MARK_CYCLES,
@@ -251,8 +352,31 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
-        self._queue.put(None)
+
+        def count_drop():
+            with self._drop_lock:
+                self._dropped += 1
+        force_put_sentinel(self._queue, count_drop)
         self._writer.join(timeout=5)
+        if self._writer.is_alive():
+            # Writer wedged mid-write (dead disk): appending the trailer
+            # from this thread would interleave with its writes and
+            # closing the handle would crash it — abandon the file; the
+            # daemon thread dies with the process.
+            return
+        with self._drop_lock:
+            dropped = self._dropped
+        # Drop accounting belongs IN the artifact: a trace missing events
+        # must say so.  The writer has exited, so the trailer writes go
+        # straight to the file handle.
+        line = json.dumps({"name": "hvd_timeline_dropped_events_total",
+                           "ph": "C", "ts": self._ts_us(),
+                           "pid": self.rank,
+                           "args": {"dropped": dropped}})
+        if not self._first:
+            self._fh.write(",\n")
+        self._first = False
+        self._fh.write(line)
         self._fh.write("\n]\n")
         self._fh.flush()
         self._fh.close()

@@ -55,7 +55,9 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
-                "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE"):
+                "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
+                "HVD_TRACE_SAMPLE", "HVD_TRACE_DIR", "HVD_TRACE_RECENT",
+                "HVD_TIMELINE_QUEUE_CAP"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -238,6 +240,19 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert faults["fired"], "the seeded plan never fired"
         assert faults["replica_events"]["mark_alive"] >= 1  # scale-up
         assert faults["outputs_match"] is True  # faults never corrupt
+        # ISSUE 9: the trace arm records the sampling-overhead contract
+        # in-band — tokens/s with the tracer absent (sample=0, the
+        # zero-overhead fast path) vs installed at sample=1 with shard
+        # files written, exactness intact either way.
+        trace = last["trace"]
+        for key in ("sample0_tokens_per_sec", "sample1_tokens_per_sec",
+                    "sampled_throughput_ratio", "outputs_match",
+                    "spans", "shards"):
+            assert key in trace, f"trace.{key} missing: {trace}"
+        assert trace["sample0_tokens_per_sec"] > 0
+        assert trace["sample1_tokens_per_sec"] > 0
+        assert trace["outputs_match"] is True  # tracing never corrupts
+        assert trace["spans"] > 0 and trace["shards"] >= 1
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -53,6 +53,11 @@ def test_lint_covers_the_whole_tree():
     for mod in ("plan.py", "runtime.py"):
         assert any(f.endswith(os.path.join("faultline", mod))
                    for f in files), f"faultline/{mod} not linted"
+    # And obs/ (ISSUE 9): the tracing plane threads through the serve
+    # hot paths and the KV client — it must stay inside the gate.
+    for mod in ("tracing.py", "merge.py", "cli.py"):
+        assert any(f.endswith(os.path.join("obs", mod))
+                   for f in files), f"obs/{mod} not linted"
     assert not any("__pycache__" in f for f in files)
 
 

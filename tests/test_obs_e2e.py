@@ -1,0 +1,235 @@
+"""hvdtrace end-to-end acceptance (ISSUE 9): a 4-replica process-set
+world under a sampled concurrent storm with a failover mid-flight, then
+the fleet merge.
+
+Pins the acceptance properties in one scenario:
+
+(a) ``hvdtrace`` merge of the shards produces a VALID Chrome-trace JSON
+    whose event timestamps are globally monotonic;
+(b) a failed-over request's span tree CROSSES replicas with correct
+    parentage: queue-wait/prefill spans on the dead replica, a
+    resubmission span + decode on the survivor, all children of the one
+    http-handle root;
+(c) ``/metrics`` exposes the per-stage ``hvd_serve_stage_ms``
+    histograms, and a request's stage sums equal its end-to-end latency
+    (the exact-partition contract);
+(d) the rendezvous-KV clock-anchor path attaches an RTT skew bound to
+    the merge.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd  # noqa: F401 - world fixture
+from horovod_tpu.elastic.preemption import PREEMPT_SCOPE
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.obs import merge as mg
+from horovod_tpu.obs import tracing as tr
+from horovod_tpu.obs.cli import run_commandline as hvdtrace_cli
+from horovod_tpu.runner.http_server import KVStoreClient, KVStoreServer
+from horovod_tpu.serve import ServeServer, TransformerAdapter, build_replicas
+
+# Serialize with the other heavy e2e files (conftest loadgroup policy).
+pytestmark = pytest.mark.xdist_group("heavy_e2e")
+
+CFG = TransformerConfig(vocab_size=89, num_layers=2, num_heads=2,
+                        d_model=32, d_ff=64, max_len=96, causal=True,
+                        dtype=jnp.float32, scan_layers=False)
+NEW_TOKENS = 12
+N_REQUESTS = 48
+
+
+def _gen(port, prompt, n=NEW_TOKENS, timeout=120):
+    body = json.dumps({"tokens": prompt, "max_new_tokens": n}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+        out["trace_id"] = resp.headers.get("X-Trace-Id")
+        return out
+
+
+def _metric_lines(port, name):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+    return [l for l in text.splitlines() if l.startswith(name)]
+
+
+def test_traced_storm_with_failover_merges_across_replicas(
+        hvd8, tmp_path):
+    shard_dir = tmp_path / "shards"
+    tracer = tr.install(tr.Tracer(sample=1.0, shard_dir=str(shard_dir)))
+    model = Transformer(CFG)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sched = build_replicas(lambda: TransformerAdapter(CFG, params),
+                           num_replicas=4, max_batch=4)
+    server = ServeServer(sched)
+    port = server.start(port=0, host="127.0.0.1")
+    kv = KVStoreServer()
+    kv_port = kv.start(0)
+    merged_path = tmp_path / "fleet.json"
+    try:
+        client = KVStoreClient("127.0.0.1", kv_port)
+        tr.publish_clock_anchor(client, "serve-world")
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, CFG.vocab_size,
+                               size=(int(rng.randint(3, 24)),)).tolist()
+                   for _ in range(N_REQUESTS)]
+        _gen(port, prompts[0])  # warm one bucket
+
+        victim = sched.replicas[0]
+        sched.watch_preemption(client,
+                               {"preempt-host": list(victim.ranks)},
+                               poll_s=0.05)
+        results = [None] * N_REQUESTS
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = _gen(port, prompts[i])
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(N_REQUESTS)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while victim.engine.active_count == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert victim.engine.active_count > 0, "victim never got load"
+        client.put(PREEMPT_SCOPE, "preempt-host",
+                   b"TERMINATE_ON_HOST_MAINTENANCE")
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        requeued = [r for r in results if r["requeues"] > 0]
+        assert requeued, "no request failed over mid-flight"
+        assert all(r["trace_id"] for r in results)  # sample=1: all traced
+
+        # (c) stage histograms on /metrics, retry stage populated by the
+        # failed-over requests.
+        assert _metric_lines(port, "hvd_serve_stage_ms_bucket")
+        retry_count = float(_metric_lines(
+            port, 'hvd_serve_stage_ms_count{stage="retry"}'
+        )[0].split()[-1])
+        assert retry_count >= len(requeued)
+
+        # (c) exact-partition: one fresh request served alone — its
+        # stage sums equal its end-to-end latency.
+        from horovod_tpu.serve import Request
+        probe = Request(prompts[0], max_new_tokens=NEW_TOKENS)
+        sched.submit(probe)
+        probe.result(timeout=120)
+        e2e_ms = (time.monotonic() - probe.submitted_at) * 1e3
+        total = sum(probe.stage_ms.values())
+        assert 0 < total <= e2e_ms + 1e-6
+        assert total >= e2e_ms - 50  # result() wakeup slack only
+    finally:
+        server.stop()
+        kv.stop()
+
+    # -- the fleet merge (tracer closed so shards are flushed) ---------------
+    tr.uninstall()
+    rc = hvdtrace_cli(["--dir", str(shard_dir), "-o", str(merged_path),
+                       "--kv", f"127.0.0.1:{kv_port}"])
+    # KV already stopped: the CLI falls back to shard anchors, still rc 0.
+    assert rc == 0
+
+    # (a) valid Chrome-trace JSON, globally monotonic timestamps.
+    events = json.load(open(merged_path))
+    assert all("ph" in e and "name" in e for e in events)
+    ts = [e["ts"] for e in events if "ts" in e]
+    assert ts and ts == sorted(ts)
+    # All four replicas plus the server contributed shards.
+    proc_names = {e["args"]["name"] for e in events
+                  if e["name"] == "process_name"}
+    assert "server" in proc_names
+    assert sum(1 for p in proc_names if p.startswith("replica-")) >= 2
+
+    # (b) the failed-over request's span tree crosses replicas with a
+    # resubmission span and correct parentage.
+    shards = mg.load_shards(str(shard_dir))
+    traces = mg.spans_by_trace(shards)
+    crossing = None
+    for r in requeued:
+        spans = [e for e in traces.get(r["trace_id"], [])
+                 if e["type"] == "span"]
+        procs = {s["proc"] for s in spans
+                 if s["proc"].startswith("replica-")}
+        if len(procs) >= 2 and any(s["name"] == "resubmission"
+                                   for s in spans):
+            crossing = (r, spans, procs)
+            break
+    assert crossing is not None, \
+        f"no requeued trace crossed replicas: {requeued}"
+    r, spans, procs = crossing
+    root = next(s for s in spans if s["name"] == "http-handle")
+    resub = next(s for s in spans if s["name"] == "resubmission")
+    assert resub["parent"] == root["span"]  # child of the request root
+    assert resub["proc"] == r["replica"]    # attributed to the survivor
+    assert r["replica"] in procs and len(procs) >= 2
+    # Every span in the tree resolves to the root.
+    by_id = {s["span"]: s for s in spans}
+    for s in spans:
+        node = s
+        hops = 0
+        while node["parent"] is not None and hops < 10:
+            node = by_id.get(node["parent"], root)
+            hops += 1
+        assert node is root
+    # The merged tree's timestamps are monotonic parent→child.
+    tree = mg.build_tree(spans)
+    assert len(tree) == 1 and tree[0]["name"] == "http-handle"
+
+    def check(node):
+        for c in node["children"]:
+            if "wall0_ns" in c and "wall0_ns" in node:
+                assert c["wall0_ns"] >= node["wall0_ns"]
+            check(c)
+    check(tree[0])
+
+    # (d) per-request critical path: the failed-over request shows
+    # retry time and both replicas.
+    cp = mg.critical_path(traces[r["trace_id"]])
+    assert cp["resubmissions"] >= 1
+    assert cp["stages_ms"]["retry"] > 0
+    assert len(cp["replicas"]) >= 2
+    assert cp["total_ms"] > 0
+
+
+def test_kv_anchor_refinement_attaches_skew_bound(hvd8, tmp_path):
+    """The rendezvous-KV clock path end-to-end: anchors published
+    through a live KV attach RTT bounds to the merged shards."""
+    shard_dir = tmp_path / "shards"
+    tracer = tr.install(tr.Tracer(sample=1.0, shard_dir=str(shard_dir)))
+    kv = KVStoreServer()
+    kv_port = kv.start(0)
+    try:
+        client = KVStoreClient("127.0.0.1", kv_port)
+        tr.publish_clock_anchor(client, "world")
+        ctx = tracer.new_context()
+        t0 = time.monotonic()
+        tracer.emit_span(ctx, "http-handle", t0, t0 + 0.01, "server",
+                         root=True)
+        tr.uninstall()
+        shards = mg.load_shards(str(shard_dir))
+        mg.apply_kv_anchors(shards, mg.kv_anchors(client))
+        assert all(s.rtt_ns is not None and s.rtt_ns > 0
+                   for s in shards)
+        _, meta = mg.merge_chrome(shards)
+        assert all(s["skew_bound_ns"] > 0 for s in meta["shards"])
+    finally:
+        tr.uninstall()
+        kv.stop()

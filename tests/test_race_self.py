@@ -60,6 +60,11 @@ def test_race_walk_covers_the_threaded_tree():
     # property is only checked if the walker actually visits it.
     assert any(f.endswith(os.path.join("serve", "paged_attention.py"))
                for f in files), "serve/paged_attention.py not analyzed"
+    # The tracing plane (ISSUE 9) holds its own lock while called from
+    # under the engine/batcher locks — its ordering must stay analyzed.
+    for mod in ("tracing.py", "merge.py"):
+        assert any(f.endswith(os.path.join("obs", mod))
+                   for f in files), f"obs/{mod} not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")
@@ -73,7 +78,8 @@ def test_race_walk_covers_the_threaded_tree():
     for label in ("DynamicBatcher._lock", "ServeMetrics._lock",
                   "InferenceEngine._lock", "ReplicaScheduler._lock",
                   "BlockManager._lock", "ElasticDriver._lock",
-                  "Negotiator._buf_lock", "Negotiator._flush_lock"):
+                  "Negotiator._buf_lock", "Negotiator._flush_lock",
+                  "Tracer._lock"):
         assert label in analyzer.lock_sites, \
             f"{label} missing from the witness registry"
     # Condition-wraps-lock aliasing: the batcher's _cond must NOT appear
