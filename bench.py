@@ -85,9 +85,27 @@ def _last_good_path():
     return os.path.join(_REPO, "artifacts", f"last_bench{suffix}.json")
 
 
+def _capture_round(record) -> object:
+    """Round identity of a persisted capture: its monotonically increasing
+    ``capture_round`` counter (stamped by ``_emit``), falling back to
+    ``captured_at`` for pre-counter records.  This is what a re-emitted
+    stale record carries as ``stale_source_round`` — the BENCH_r05
+    confusion was a stale re-emission whose provenance was only
+    reconstructible by diffing round files."""
+    return record.get("capture_round", record.get("captured_at", "unknown"))
+
+
 def _emit(record):
     """Print the one-JSON-line contract AND persist it for outage fallback."""
     record = dict(record)
+    # Fresh captures get a round counter so any later stale re-emission
+    # can name its source round in-band (stale_source_round).
+    try:
+        with open(_last_good_path()) as f:
+            prev_round = json.load(f).get("capture_round", 0)
+    except (OSError, ValueError):
+        prev_round = 0
+    record["capture_round"] = int(prev_round) + 1
     print(json.dumps(record), flush=True)
     path = _last_good_path()
     try:
@@ -122,6 +140,7 @@ def _emit_stale_first():
     except (OSError, ValueError):
         return False
     record["stale"] = True
+    record["stale_source_round"] = _capture_round(record)
     record["stale_reason"] = (
         "emitted at process start before device probe; superseded by any "
         "later stdout line")
@@ -920,7 +939,8 @@ def _wait_for_devices(have_stale):
             with open(_last_good_path()) as f:
                 record = json.load(f)
             record.update(
-                stale=True, probe_failed=True, probe_attempts=attempt,
+                stale=True, stale_source_round=_capture_round(record),
+                probe_failed=True, probe_attempts=attempt,
                 probe_seconds=round(elapsed, 1),
                 stale_reason=("re-emitted at probe deadline (fail-fast); "
                               "originally captured earlier and printed at "
@@ -1045,6 +1065,12 @@ def main():
     if reports:
         record["collective_census"] = reports[-1].census
         record["analysis_findings"] = len(reports[-1].findings)
+        # hvdmem rode along on the same trace: the step program's peak
+        # live footprint + per-primitive allocation breakdown, so a perf
+        # number also names the memory it ran in (analysis/memplan.py).
+        mem = getattr(reports[-1], "memory", None)
+        if mem:
+            record["memory_census"] = mem
     _emit(record)
 
 

@@ -19,19 +19,31 @@ Two cooperating layers (see docs/static_analysis.md):
   lock-witness sanitizer — wraps ``threading`` locks, maintains the
   order graph live, records HVD210 (observed inversion) / HVD211
   (timeout-less wait holding a second lock) findings.
+* **hvdmem** (memplan.py): static HBM liveness/donation/budget analysis
+  — a jaxpr liveness walk (peak-live-bytes estimate + per-primitive
+  memory census, HVD300/302/303/304, ridden by the ``HVD_ANALYZE=1``
+  hook and the serve engine's pool-budget check) and an AST half
+  (``--mem``: HVD300/HVD301 donation hazards at the source level).
 
 CLI: ``python -m horovod_tpu.analysis <paths>`` (or the ``hvdlint``
 console script / ``tools/hvdlint.py`` shim); exit 0 clean, 1 findings,
-2 internal error.  Trace-time mode: ``HVD_ANALYZE=1`` (hook.py);
-runtime lock witness: ``HVD_SANITIZE=1`` (witness.py).
+2 internal error — every pass registered in one table (cli.PASSES).
+Trace-time mode: ``HVD_ANALYZE=1`` (hook.py); runtime lock witness:
+``HVD_SANITIZE=1`` (witness.py).
 """
 
-from .findings import ERROR, WARNING, Finding, Rule, RULES, unsuppressed  # noqa: F401
+from .findings import ERROR, WARNING, Finding, Rule, RULES, \
+    rule_selected, unsuppressed  # noqa: F401
 from .linter import lint_file, lint_paths, lint_source, iter_python_files  # noqa: F401
 from .jaxpr_check import JaxprReport, check_closed_jaxpr, check_step_fn  # noqa: F401
 from .lockgraph import (analyze_paths as race_paths,  # noqa: F401
                         analyze_source as race_source,
                         analyze_sources as race_sources)
+from .memplan import (MemReport, check_pool_budget,  # noqa: F401
+                      device_budget_bytes, measure_closed_jaxpr,
+                      measure_step_fn,
+                      analyze_paths as mem_paths,
+                      analyze_source as mem_source)
 from .cli import main  # noqa: F401
 from . import hook  # noqa: F401
 from . import witness  # noqa: F401

@@ -11,43 +11,105 @@ Exit codes (CI contract, mirrored by tools/hvdlint.py and the
 Text output prints one block per finding (location, rule, severity,
 message, fix hint); ``--format json`` prints a single machine-readable
 object with the findings plus per-rule statistics.
+
+Passes are registered in ONE table (``PASSES``): name → walker, rule
+range, default paths.  Adding an analyzer means adding a row — the
+dispatch, flag wiring, select/ignore filtering (prefix-matching:
+``--select HVD3`` runs the whole HVD3xx family), pragma handling, and
+the exit-code contract all come for free and stay identical across
+lint (HVD0xx), ``--race`` (HVD2xx), and ``--mem`` (HVD3xx).
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 from collections import Counter
-from typing import List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from .findings import RULES, unsuppressed
-from .linter import lint_paths
 
 
 def _split_ids(value: str) -> List[str]:
     return [tok.strip().upper() for tok in value.split(",") if tok.strip()]
 
 
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+def _run_lint(paths, select, ignore):
+    from .linter import lint_paths
+    return lint_paths(paths, select=select, ignore=ignore)
+
+
+def _run_race(paths, select, ignore):
+    from .lockgraph import analyze_paths
+    return analyze_paths(paths, select=select, ignore=ignore)
+
+
+def _run_mem(paths, select, ignore):
+    from .memplan import analyze_paths
+    return analyze_paths(paths, select=select, ignore=ignore)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyzerPass:
+    """One analyzer: its CLI identity, rule family, and path walker."""
+
+    name: str              # registry key; non-default passes get --<name>
+    rules: str             # human-readable rule range for --help
+    runner: Callable       # (paths, select, ignore) -> List[Finding]
+    help: str
+    default_paths: tuple = (".",)
+
+
+PASSES: Dict[str, AnalyzerPass] = {
+    "lint": AnalyzerPass(
+        "lint", "HVD001-HVD009",
+        _run_lint,
+        "AST distributed-correctness rules (the default pass)"),
+    "race": AnalyzerPass(
+        "race", "HVD200-HVD203",
+        _run_race,
+        "hvdrace lock-order & thread-lifecycle analysis over the given "
+        "paths as ONE global lock graph"),
+    "mem": AnalyzerPass(
+        "mem", "HVD300-HVD304",
+        _run_mem,
+        "hvdmem HBM donation hazards: donated-then-used reads and "
+        "donatable-but-undonated jit args (the liveness walk itself "
+        "runs trace-time under HVD_ANALYZE=1, docs/static_analysis.md)"),
+}
+DEFAULT_PASS = "lint"
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdlint",
-        description="Distributed-correctness static analyzer for "
-                    "horovod_tpu training code (rules HVD001-HVD009; "
-                    "--race runs the hvdrace lock-order/thread-lifecycle "
-                    "analysis, HVD200-HVD203; see docs/static_analysis.md)")
-    p.add_argument("paths", nargs="*", default=["."],
-                   help="files or directories to lint (default: .)")
-    p.add_argument("--race", action="store_true",
-                   help="run hvdrace instead: the lock-order & "
-                        "thread-lifecycle analysis (rules HVD200-HVD203) "
-                        "over the given paths as ONE global lock graph; "
-                        "same output formats, pragmas, and exit codes")
+        description="Distributed-correctness static analyzers for "
+                    "horovod_tpu (default pass: AST lint HVD001-HVD009; "
+                    "--race HVD200-HVD203; --mem HVD300-HVD304; see "
+                    "docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to analyze (default: .)")
+    mode = p.add_mutually_exclusive_group()
+    for name, pass_ in PASSES.items():
+        if name == DEFAULT_PASS:
+            continue
+        mode.add_argument(
+            f"--{name}", action="store_true",
+            help=f"run the {name} pass instead ({pass_.rules}): "
+                 f"{pass_.help}; same output formats, pragmas, and "
+                 f"exit codes")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--select", type=_split_ids, default=[],
-                   help="comma-separated rule IDs to run exclusively")
+                   help="comma-separated rule IDs (or prefixes: HVD3 "
+                        "selects all HVD3xx) to run exclusively")
     p.add_argument("--ignore", type=_split_ids, default=[],
-                   help="comma-separated rule IDs to skip")
+                   help="comma-separated rule IDs/prefixes to skip")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print findings silenced by '# hvdlint: "
                         "disable=...' pragmas")
@@ -67,14 +129,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.list_rules:
         _print_rules()
         return 0
+    chosen = [name for name in PASSES
+              if name != DEFAULT_PASS and getattr(args, name, False)]
+    pass_ = PASSES[chosen[0] if chosen else DEFAULT_PASS]
+    paths = args.paths if args.paths else list(pass_.default_paths)
     try:
-        if args.race:
-            from .lockgraph import analyze_paths
-            findings = analyze_paths(args.paths, select=args.select,
-                                     ignore=args.ignore)
-        else:
-            findings = lint_paths(args.paths, select=args.select,
-                                  ignore=args.ignore)
+        findings = pass_.runner(paths, args.select, args.ignore)
     except Exception as e:  # internal error: distinct from "has findings"
         print(f"hvdlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -84,6 +144,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.format == "json":
         by_rule = Counter(f.rule for f in active)
         print(json.dumps({
+            "pass": pass_.name,
             "findings": [f.to_dict() for f in shown],
             "summary": {
                 "total": len(active),

@@ -141,6 +141,49 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "other lock is held until a wakeup that may never come",
          "wait with a bounded timeout and re-check, or release the "
          "second lock before waiting"),
+    # -- hvdmem HBM liveness / donation / budget rules ----------------------
+    Rule("HVD300", WARNING,
+         "donatable-but-undonated: a jit/pjit argument whose shape+dtype "
+         "matches an output and is dead after its last read, yet absent "
+         "from donate_argnums — XLA holds both the old and the new "
+         "buffer live, doubling that value's steady-state footprint "
+         "(donating the KV cache halves decode memory)",
+         "add the argument's index to donate_argnums so XLA aliases the "
+         "update in place (and never read the donated value after the "
+         "call)"),
+    Rule("HVD301", ERROR,
+         "donated-then-used: a value passed into a donated argument slot "
+         "is referenced again after the call — the buffer was consumed "
+         "by donation and the read raises at runtime (the PR 4 "
+         "donated-then-consumed cache hazard, caught statically instead "
+         "of via is_deleted)",
+         "rebind the name to the call's result (cache, out = fn(cache, "
+         "...)) or drop the donation for a value that must survive"),
+    Rule("HVD302", ERROR,
+         "peak-exceeds-budget: the estimated peak live footprint (or the "
+         "serve pool's bytes_per_block * num_blocks + weight bytes) "
+         "exceeds HVD_MEM_BUDGET_BYTES / the probed device HBM — the "
+         "program OOMs the chip at runtime, discovered only after "
+         "minutes of compile",
+         "shrink the pool (HVD_SERVE_NUM_BLOCKS), quantize KV blocks "
+         "(HVD_SERVE_KV_DTYPE=int8), donate dead inputs, or raise the "
+         "budget if the probe undershoots the real HBM"),
+    Rule("HVD303", WARNING,
+         "silent-upcast blowup: bf16/f16 values flow through ops that "
+         "promote them to f32, widening the live set 2x — the "
+         "f32-serving-island footprint made visible (intentional f32 "
+         "islands under HVD_SERVE_DTYPE/documented knobs should be "
+         "small; a whole param/activation set widening is a leak)",
+         "keep the wide island minimal (layernorm-style), or store/"
+         "compute in the narrow dtype and cast per-tile inside the "
+         "kernel"),
+    Rule("HVD304", WARNING,
+         "fusion-buffer overshoot: a fused flat-buffer bucket exceeds "
+         "the tensor-fusion threshold knob (HOROVOD_FUSION_THRESHOLD) — "
+         "the bucket transiently costs its full size twice (memcpy-in + "
+         "collective result), past what the knob budgeted",
+         "lower the bucket size or raise the threshold knowingly; "
+         "autotune (HOROVOD_AUTOTUNE=1) finds the sweet spot"),
     # -- trace-level (jaxpr) rules -----------------------------------------
     Rule("HVD100", ERROR,
          "the step function failed to trace — the jaxpr checker reports the "
@@ -176,7 +219,7 @@ class Finding:
     severity: str = ""
     fix_hint: str = ""
     suppressed: bool = False
-    source: str = "lint"  # "lint" | "jaxpr" | "race" | "witness"
+    source: str = "lint"  # "lint" | "jaxpr" | "race" | "witness" | "mem"
 
     def __post_init__(self):
         rule = RULES.get(self.rule)
@@ -198,3 +241,16 @@ class Finding:
 
 def unsuppressed(findings) -> list:
     return [f for f in findings if not f.suppressed]
+
+
+def rule_selected(rule: str, select=(), ignore=()) -> bool:
+    """Shared --select/--ignore filter for every analyzer pass.  Tokens
+    match exactly OR as prefixes (``--select HVD3`` runs the whole
+    HVD3xx family), uniformly across lint/race/mem; ``select`` wins when
+    both are given (the usual linter contract), and applies to every
+    rule including HVD000 analysis failures."""
+    def hit(tokens) -> bool:
+        return any(rule == tok or rule.startswith(tok) for tok in tokens)
+    if select:
+        return hit(select)
+    return not hit(ignore)

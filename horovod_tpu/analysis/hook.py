@@ -9,7 +9,16 @@ a step program first becomes visible:
 * ``DistributedOptimizer`` — analyzes the gradient-reduction program of
   an *eagerly* driven optimizer (no surrounding shard_step) by tracing
   its update under the framework axis, once per optimizer
-  instance/generation.
+  instance/generation;
+* the serve engine's prefill/decode builders — registered per compile
+  bucket via ``InferenceEngine``'s adapter (engine._maybe_analyze), so
+  serve-phase programs get the same census + HVD1xx walk (and must
+  census zero collectives — the ROADMAP-5 invariant).
+
+Every analysis also runs the hvdmem liveness walk over the SAME traced
+program (memplan.py): the memory census attaches to the report
+(``JaxprReport.memory``), HVD300/302/303/304 findings merge into its
+finding list, and ``Timeline.memory_census`` charts it.
 
 Findings are logged as warnings, the report is appended to
 ``core._state.analysis_reports`` (``core.analysis_reports()``), and the
@@ -64,12 +73,16 @@ def analyze_traceable(fn, args: Sequence[Any],
                       label: str,
                       declared_axes: Optional[Sequence[str]] = None,
                       axis_env: Optional[Sequence[Tuple[str, int]]] = None,
-                      once: bool = True):
+                      once: bool = True,
+                      donate_argnums: Optional[Sequence[int]] = None):
     """Check ``fn(*args)``; returns the JaxprReport (or None when
     disabled/already done/failed).  ``once=True`` dedupes globally by
     ``label``; callers that own their dedup (shard_step's per-wrapper
     generation tracking, which labels aren't unique enough for) pass
-    ``once=False``.  Safe to call on the hot path."""
+    ``once=False``.  ``donate_argnums`` is the donation the deployment
+    compiles with (feeds the hvdmem HVD300 donation check; a jitted
+    ``fn`` carries its own ``donated_invars``, so leave it None there).
+    Safe to call on the hot path."""
     if not enabled():
         return None
     if once:
@@ -87,6 +100,24 @@ def analyze_traceable(fn, args: Sequence[Any],
         log.warning("HVD_ANALYZE: analysis of %s failed: %s: %s",
                     label, type(e).__name__, e)
         return None
+    # hvdmem ride-along: liveness-walk the SAME traced program (no
+    # second trace) — peak live bytes, per-primitive allocation
+    # breakdown, donation/budget/upcast rules HVD300/302/303/304.
+    closed = getattr(report, "_closed_jaxpr", None)
+    if closed is not None:
+        try:
+            from . import memplan
+            mem = memplan.measure_closed_jaxpr(
+                closed, label=label,
+                # Per-argument donation expanded to per-leaf invar flags
+                # (a donated PYTREE arg donates every one of its leaves).
+                donated_invars=memplan.donated_invar_flags(
+                    args, donate_argnums))
+            report.memory = mem.to_dict()
+            report.findings.extend(mem.findings)
+        except Exception as e:  # analysis must never break training
+            log.warning("HVD_ANALYZE: memory analysis of %s failed: "
+                        "%s: %s", label, type(e).__name__, e)
     _publish(report, log)
     return report
 
@@ -106,6 +137,9 @@ def _publish(report, log) -> None:
         tl = st.timeline
         if tl is not None and report.census:
             tl.collective_census(report.label, report.census)
+        mem = getattr(report, "memory", None)
+        if tl is not None and mem:
+            tl.memory_census(report.label, mem)
     except Exception as e:  # pragma: no cover - publication is best-effort
         log.warning("HVD_ANALYZE: could not publish report: %s", e)
 

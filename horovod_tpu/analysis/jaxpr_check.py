@@ -55,6 +55,10 @@ class JaxprReport:
     census: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
     dynamic_loops: int = 0   # while-loops whose trip count is unknown
+    # hvdmem liveness walk of the same program (memplan.MemReport
+    # .to_dict(); attached by the HVD_ANALYZE hook): peak_live_bytes,
+    # per-primitive allocation breakdown, budget headroom.
+    memory: Optional[dict] = None
 
     def ok(self) -> bool:
         return not self.findings
@@ -69,7 +73,8 @@ class JaxprReport:
         return {"label": self.label,
                 "findings": [f.to_dict() for f in self.findings],
                 "census": self.census,
-                "dynamic_loops": self.dynamic_loops}
+                "dynamic_loops": self.dynamic_loops,
+                "memory": self.memory}
 
 
 # -- jaxpr plumbing ---------------------------------------------------------
@@ -292,4 +297,9 @@ def check_step_fn(fn: Callable,
     declared: Optional[Sequence[str]] = declared_axes
     if declared is None and axis_env:
         declared = [a for a, _ in axis_env]
-    return check_closed_jaxpr(traced, declared_axes=declared, label=name)
+    report = check_closed_jaxpr(traced, declared_axes=declared, label=name)
+    # Stash the traced program so downstream analyses (the hvdmem
+    # liveness walk in analysis/hook.py) reuse this trace instead of
+    # paying a second one; not part of to_dict().
+    report._closed_jaxpr = traced
+    return report

@@ -77,11 +77,12 @@ def _suppressed(f: Finding, per_line: Dict[int, Set[str]],
 
 def _rule_selected(rule: str, select: Sequence[str],
                    ignore: Sequence[str]) -> bool:
-    """select wins when both are given (usual linter contract); applies
-    uniformly to every rule — including HVD000 analysis failures."""
-    if select:
-        return rule in select
-    return rule not in ignore
+    """Shared filter (findings.rule_selected): select wins when both are
+    given, tokens match exactly or as prefixes (``--select HVD3``), and
+    the contract applies uniformly to every pass and rule — including
+    HVD000 analysis failures."""
+    from .findings import rule_selected
+    return rule_selected(rule, select, ignore)
 
 
 def lint_source(source: str, path: str = "<string>",

@@ -119,7 +119,10 @@ def shard_step(fn: Callable,
             _analysis_hook.analyze_traceable(
                 mapped, args,
                 label=f"shard_step:{getattr(fn, '__name__', 'fn')}/{key}",
-                declared_axes=tuple(mesh.axis_names), once=False)
+                declared_axes=tuple(mesh.axis_names), once=False,
+                # The deployment's actual donation: hvdmem's HVD300
+                # check measures undonated-but-donatable args against it.
+                donate_argnums=donate_argnums)
         return jitted(*args)
 
     return wrapper

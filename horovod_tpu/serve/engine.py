@@ -213,6 +213,23 @@ class TransformerAdapter(ModelAdapter):
         self._max_batch = None
         self._num_blocks = None
 
+    # -- trace-time analysis (HVD_ANALYZE=1) ---------------------------------
+
+    def _maybe_analyze(self, kind: str, key, fn, args) -> None:
+        """HVD_ANALYZE ride-along for the serve-phase programs (the
+        ROADMAP-5 lint gap): the first compile of every prefill/decode
+        bucket gets the same collective-census + HVD101/102 walk — and
+        the hvdmem liveness walk — that a training step gets.  Serve
+        programs must census ZERO collectives (a replica is
+        data-parallel and self-contained); that invariant is pinned by
+        tests/test_memplan.py.  One env read when disabled; trace-only,
+        so the donated cache argument is never consumed."""
+        from ..analysis import hook as _hook
+        if not _hook.enabled():
+            return
+        label = f"serve:{kind}[{','.join(str(k) for k in key)}]"
+        _hook.analyze_traceable(fn, args, label=label)
+
     # -- cache --------------------------------------------------------------
 
     @property
@@ -422,9 +439,11 @@ class TransformerAdapter(ModelAdapter):
             tokens[i, :len(p)] = p
             lengths[i] = len(p)
             slot_arr[i] = slots[i]
-        cache, nxt = self._prefill_cache[key](
-            self.params, cache, jnp.asarray(tokens), jnp.asarray(lengths),
-            jnp.asarray(slot_arr))
+        call_args = (self.params, cache, jnp.asarray(tokens),
+                     jnp.asarray(lengths), jnp.asarray(slot_arr))
+        self._maybe_analyze("prefill", key, self._prefill_cache[key],
+                            call_args)
+        cache, nxt = self._prefill_cache[key](*call_args)
         return cache, np.asarray(nxt)[:len(prompts)]
 
     # -- chunked prefill (paged mode) ----------------------------------------
@@ -543,9 +562,11 @@ class TransformerAdapter(ModelAdapter):
             st[i] = s0
             ln[i] = len(ch)
             tab[i, :len(t)] = t
-        cache, nxt = self._chunk_cache[key](
-            self.params, cache, jnp.asarray(tok), jnp.asarray(st),
-            jnp.asarray(ln), jnp.asarray(tab))
+        call_args = (self.params, cache, jnp.asarray(tok), jnp.asarray(st),
+                     jnp.asarray(ln), jnp.asarray(tab))
+        self._maybe_analyze("prefill_chunk", key, self._chunk_cache[key],
+                            call_args)
+        cache, nxt = self._chunk_cache[key](*call_args)
         return cache, np.asarray(nxt)[:len(chunks)]
 
     # -- decode (slot mode) --------------------------------------------------
@@ -593,9 +614,11 @@ class TransformerAdapter(ModelAdapter):
         import jax.numpy as jnp
         if self._decode_fns.get(self._max_batch) is None:
             self._decode_fns[self._max_batch] = self._build_decode()
-        cache, nxt = self._decode_fns[self._max_batch](
-            self.params, cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32))
+        call_args = (self.params, cache, jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(positions, jnp.int32))
+        self._maybe_analyze("decode", (self._max_batch,),
+                            self._decode_fns[self._max_batch], call_args)
+        cache, nxt = self._decode_fns[self._max_batch](*call_args)
         return cache, np.asarray(nxt)
 
     # -- decode (paged mode) -------------------------------------------------
@@ -646,10 +669,12 @@ class TransformerAdapter(ModelAdapter):
         if self._paged_decode_fns.get(key) is None:
             self._paged_decode_fns[key] = self._build_paged_decode(
                 len(tokens))
-        cache, nxt = self._paged_decode_fns[key](
-            self.params, cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(tables, jnp.int32))
+        call_args = (self.params, cache, jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(positions, jnp.int32),
+                     jnp.asarray(tables, jnp.int32))
+        self._maybe_analyze("decode_paged", key,
+                            self._paged_decode_fns[key], call_args)
+        cache, nxt = self._paged_decode_fns[key](*call_args)
         return cache, np.asarray(nxt)
 
     def copy_block(self, cache, src: int, dst: int):
@@ -836,9 +861,12 @@ class InferenceEngine:
             # iteration (the unchunked bench/interference baseline).
             self._chunk_budget = chunk if chunk > 0 else None
             self._cache = adapter.init_paged_cache(nb, self.max_batch)
+            self._verify_pool_budget(nb)
         else:
             self._mb = 0
             self._cache = adapter.init_cache(self.max_batch)
+            self.pool_bytes = self.weight_bytes = 0
+            self.kv_headroom_bytes: Optional[int] = None
         self._slots: List[Optional[object]] = [None] * self.max_batch
         # Deferred trace emissions (loop-thread only): span/flow
         # emission does shard-file IO under the tracer's lock, and the
@@ -863,6 +891,31 @@ class InferenceEngine:
         # the same None-check hot-path discipline.
         _obs.maybe_install_from_env()
 
+    def _verify_pool_budget(self, num_blocks: int) -> None:
+        """hvdmem HVD302 at construction (docs/serving.md kv_headroom):
+        verify the BlockManager's sizing — ``paged_block_bytes() *
+        num_blocks`` plus this replica's weight bytes — against
+        ``HVD_MEM_BUDGET_BYTES`` / the probed device HBM, BEFORE the
+        first request can OOM the chip.  The headroom is exposed as
+        ``kv_headroom_bytes`` on ``kv_stats()`` → healthz + /metrics; an
+        overshoot is logged and published to ``core.analysis_reports()``
+        exactly like a trace-time finding."""
+        from ..analysis import memplan as _memplan
+        pool_bytes = (self.blocks.bytes_per_block or 0) * num_blocks
+        if not pool_bytes:
+            # Adapter reports no per-block cost (e.g. a cache-free MLP):
+            # fall back to what the pool arrays actually hold.
+            pool_bytes = _memplan.params_bytes(self._cache)
+        self.pool_bytes = int(pool_bytes)
+        self.weight_bytes = _memplan.params_bytes(
+            getattr(self.adapter, "params", None))
+        report = _memplan.check_pool_budget(
+            f"serve:{self.replica_id}:kv-pool", self.pool_bytes,
+            self.weight_bytes)
+        self.kv_headroom_bytes = report.headroom_bytes
+        if not report.ok():
+            _memplan.publish_report(report)
+
     # -- introspection -------------------------------------------------------
 
     @property
@@ -884,6 +937,13 @@ class InferenceEngine:
         stats = self.blocks.stats()
         stats["attn_impl"] = self.attn_impl
         stats["kv_dtype"] = self.kv_dtype
+        # hvdmem pool-budget plan (docs/serving.md kv_headroom): the
+        # pool + weight bytes this replica holds, and — when a budget is
+        # known (HVD_MEM_BUDGET_BYTES / probed HBM) — the headroom left.
+        stats["pool_bytes"] = self.pool_bytes
+        stats["weight_bytes"] = self.weight_bytes
+        if self.kv_headroom_bytes is not None:
+            stats["kv_headroom_bytes"] = self.kv_headroom_bytes
         return stats
 
     # -- lifecycle -----------------------------------------------------------

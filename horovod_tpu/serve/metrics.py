@@ -364,6 +364,16 @@ class ServeMetrics:
                     lines.append(
                         f'hvd_serve_kv_bytes_per_token{{replica="{rid}"}} '
                         f'{s["kv_bytes_per_token"]:g}')
+            # hvdmem pool-budget headroom (docs/serving.md kv_headroom):
+            # budget − (pool + weights), negative = the HVD302 overshoot
+            # condition; present only when a budget is known
+            # (HVD_MEM_BUDGET_BYTES / probed HBM).
+            lines.append("# TYPE hvd_serve_kv_headroom_bytes gauge")
+            for rid, s in sorted(kv.items()):
+                if "kv_headroom_bytes" in s:
+                    lines.append(
+                        f'hvd_serve_kv_headroom_bytes{{replica="{rid}"}} '
+                        f'{s["kv_headroom_bytes"]}')
             lines.append("# TYPE hvd_serve_attention_impl gauge")
             for rid, s in sorted(kv.items()):
                 if "attn_impl" in s:

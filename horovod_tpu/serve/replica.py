@@ -89,6 +89,14 @@ class Replica:
                                 ("total", "used", "free", "retained")}
             if "bytes_per_block" in kv:
                 out["kv_blocks"]["bytes_per_block"] = kv["bytes_per_block"]
+            # hvdmem budget plan: pool + weight bytes, and the headroom
+            # against HVD_MEM_BUDGET_BYTES / probed HBM when known —
+            # surfaced on healthz so an operator sees a mis-sized
+            # BlockManager before it OOMs (docs/serving.md).
+            for extra in ("pool_bytes", "weight_bytes",
+                          "kv_headroom_bytes"):
+                if extra in kv:
+                    out["kv_blocks"][extra] = kv[extra]
         return out
 
 

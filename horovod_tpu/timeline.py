@@ -73,6 +73,14 @@ WITNESS = "WITNESS"
 # lifecycle.
 COLLECTIVE_CENSUS = "COLLECTIVE_CENSUS"
 
+# Static per-step MEMORY census (hvdmem, analysis/memplan.py): the
+# jaxpr liveness walk's peak-live-bytes estimate and per-primitive
+# allocation breakdown, plus the serve engine's pool-budget plan
+# (pool + weights vs HVD_MEM_BUDGET_BYTES).  Rendered as counter
+# events so the viewer charts the footprint a program was PLANNED to
+# have next to what the op lifecycle actually did with it.
+MEMORY_CENSUS = "MEMORY_CENSUS"
+
 # Distributed request tracing (obs/tracing.py, docs/observability.md):
 # per-request spans render as Chrome ASYNC events ("b"/"e") keyed by the
 # request's trace_id, so one /generate call's http-handle → route →
@@ -238,6 +246,26 @@ class Timeline:
         for prim in sorted(census):
             info = census[prim]
             self._put({"name": f"{COLLECTIVE_CENSUS}/{step_name}/{prim}",
+                       "ph": "C", "ts": self._ts_us(), "pid": self.rank,
+                       "args": {"count": int(info.get("count", 0)),
+                                "bytes": int(info.get("bytes", 0))}})
+
+    def memory_census(self, step_name: str, mem: dict):
+        """Per-program memory census from the hvdmem liveness walk
+        (HVD_ANALYZE=1, analysis/memplan.py): one totals counter (peak /
+        input / output / budget-headroom bytes) plus one counter per
+        allocating primitive, mirroring ``collective_census``."""
+        totals = {"peak_live_bytes": int(mem.get("peak_live_bytes", 0)),
+                  "input_bytes": int(mem.get("input_bytes", 0)),
+                  "output_bytes": int(mem.get("output_bytes", 0))}
+        if mem.get("headroom_bytes") is not None:
+            totals["headroom_bytes"] = int(mem["headroom_bytes"])
+        self._put({"name": f"{MEMORY_CENSUS}/{step_name}", "ph": "C",
+                   "ts": self._ts_us(), "pid": self.rank, "args": totals})
+        by_prim = mem.get("by_primitive") or {}
+        for prim in sorted(by_prim):
+            info = by_prim[prim]
+            self._put({"name": f"{MEMORY_CENSUS}/{step_name}/{prim}",
                        "ph": "C", "ts": self._ts_us(), "pid": self.rank,
                        "args": {"count": int(info.get("count", 0)),
                                 "bytes": int(info.get("bytes", 0))}})

@@ -57,7 +57,8 @@ def _bench_env(tag, **overrides):
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
                 "HVD_TRACE_SAMPLE", "HVD_TRACE_DIR", "HVD_TRACE_RECENT",
-                "HVD_TIMELINE_QUEUE_CAP"):
+                "HVD_TIMELINE_QUEUE_CAP", "HVD_ANALYZE",
+                "HVD_MEM_BUDGET_BYTES", "HVD_MEM_UPCAST_MIN_BYTES"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -91,6 +92,10 @@ def test_stale_record_emitted_before_probe(planted_record):
     assert records, f"no JSON line on stdout: {r.stdout!r} / {r.stderr!r}"
     last = records[-1]
     assert last["stale"] is True
+    # Stale provenance is top-level and in-band (the BENCH_r05 stale
+    # re-emission confusion): a re-emitted record names its source round
+    # (capture_round counter; captured_at for pre-counter records).
+    assert last["stale_source_round"] == planted_record["captured_at"]
     assert last["value"] == planted_record["value"]
     assert "process start" in last["stale_reason"]
     assert "no usable accelerator" in r.stderr
@@ -132,6 +137,7 @@ def test_probe_deadline_emits_fail_fast_record(planted_record):
     assert len(records) >= 2  # emit-first floor + fail-fast re-emission
     last = records[-1]
     assert last["stale"] is True
+    assert last["stale_source_round"] == planted_record["captured_at"]
     assert last["probe_failed"] is True
     assert last["probe_attempts"] >= 1
     assert last["probe_seconds"] >= 0
@@ -283,9 +289,11 @@ def test_fresh_capture_supersedes_stale(tmp_path):
         assert r.returncode == 0, r.stderr[-1500:]
         records = _json_lines(r.stdout)
         assert records[0].get("stale") is True     # emit-first floor
+        assert records[0]["stale_source_round"]    # provenance in-band
         assert records[0]["value"] == 99.9
         last = records[-1]
         assert "stale" not in last                 # superseded by fresh
+        assert last["capture_round"] >= 1          # round counter stamped
         assert last["metric"] == "resnet50_synthetic_images_per_sec"
         assert "SMOKE" in last["config"]
         # HVD_ANALYZE=1 rode along: the shard_step hook checked the step
@@ -295,6 +303,13 @@ def test_fresh_capture_supersedes_stale(tmp_path):
         assert census["psum"]["count"] >= 1
         assert census["psum"]["bytes"] > 0
         assert last["analysis_findings"] == 0
+        # ... and the hvdmem liveness walk rode the same trace: the
+        # step's peak live footprint + allocation breakdown land under
+        # memory_census (analysis/memplan.py).
+        mem = last["memory_census"]
+        assert mem["peak_live_bytes"] > 0
+        assert mem["input_bytes"] > 0
+        assert mem["by_primitive"]
         with open(path) as f:
             persisted = json.load(f)
         assert persisted["value"] == last["value"]  # persisted for next time

@@ -58,6 +58,10 @@ def test_lint_covers_the_whole_tree():
     for mod in ("tracing.py", "merge.py", "cli.py"):
         assert any(f.endswith(os.path.join("obs", mod))
                    for f in files), f"obs/{mod} not linted"
+    # And the hvdmem analyzer itself (ISSUE 10): memplan.py must pass
+    # the lint the rest of the repo is held to.
+    assert any(f.endswith(os.path.join("analysis", "memplan.py"))
+               for f in files), "analysis/memplan.py not linted"
     assert not any("__pycache__" in f for f in files)
 
 

@@ -65,6 +65,10 @@ def test_race_walk_covers_the_threaded_tree():
     for mod in ("tracing.py", "merge.py"):
         assert any(f.endswith(os.path.join("obs", mod))
                    for f in files), f"obs/{mod} not analyzed"
+    # The hvdmem analyzer (ISSUE 10) is lock-free by design (pure AST +
+    # jaxpr walks) — a property only checked if the walk visits it.
+    assert any(f.endswith(os.path.join("analysis", "memplan.py"))
+               for f in files), "analysis/memplan.py not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")

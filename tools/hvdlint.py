@@ -6,7 +6,8 @@ the single command the verify recipe / CI calls; it exits nonzero on any
 unsuppressed finding (same contract as ``python -m horovod_tpu.analysis``
 and the ``hvdlint`` console script — see docs/static_analysis.md).
 ``--race`` passes through to the hvdrace lock-order/thread-lifecycle
-analysis (HVD2xx) with the identical exit-code contract.
+analysis (HVD2xx) and ``--mem`` to the hvdmem HBM donation analysis
+(HVD3xx), both with the identical exit-code contract.
 """
 
 import os
