@@ -52,6 +52,10 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_KV_MODE": "auto",
                  "HVD_SERVE_ATTN_IMPL": "auto",
                  "HVD_SERVE_KV_DTYPE": "native",
+                 "HVD_SERVE_SPEC_K": "0",
+                 "HVD_SERVE_DRAFT_LAYERS": "0",
+                 "BENCH_SERVE_SPEC_K": "4",
+                 "BENCH_SERVE_SAMPLE_TEMP": "0.8",
                  "HVD_FAULTLINE_SEED": "0",
                  "HVD_FAULTLINE_PLAN": "",
                  "HVD_TRACE_SAMPLE": "0",
@@ -368,7 +372,17 @@ def bench_serve():
       storm with the hvdtrace tracer absent (sample=0, the zero-
       overhead contract — acceptance: ≤2% tokens/s regression, tracked
       against the record's main trajectory) vs installed at sample=1
-      with shard files written, with in-band exactness."""
+      with shard files written, with in-band exactness;
+    * ``spec``     — speculative decoding (ISSUE 11): the identical
+      greedy storm non-spec vs spec (truncated-stack draft,
+      ``BENCH_SERVE_SPEC_K``): in-band bit-exactness plus the
+      amortization statistic target-model decode invocations per
+      emitted token (acceptance: ≤ 0.67 at k=4);
+    * ``sampling`` — seeded sampling (ISSUE 11): the identical sampled
+      storm (fixed per-request seeds) run twice must produce identical
+      outputs, and an n=4 CoW-forked n-best request's peak pool bytes
+      must sit strictly below 4x the n=1 footprint (prompt blocks
+      shared through the BlockManager's copy-on-write tables)."""
     import threading
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
@@ -849,6 +863,144 @@ def bench_serve():
         "shards": shard_count,
     }
 
+    # -- arm 6: speculative decoding (ISSUE 11) -------------------------------
+    # The identical greedy storm, non-speculative vs speculative with a
+    # truncated-stack draft (HVD_SERVE_DRAFT_LAYERS, arm default 1) at
+    # BENCH_SERVE_SPEC_K.  Greedy speculation is bit-identical to plain
+    # greedy by construction (the engine accepts while draft == target
+    # argmax and emits the target's token at the first mismatch), so
+    # outputs_match is checked in-band; the amortization statistic is
+    # target-model decode invocations per emitted decode token — per
+    # sequence, one verify step emits accepted+1 tokens, so
+    # calls/token = (emitted - accepted) / emitted (1.0 without spec,
+    # 1/(k+1) at full acceptance).  Acceptance bar: <= 0.67 (>= 1.5x).
+    spec_k = int(os.environ.get("BENCH_SERVE_SPEC_K",
+                                KNOB_DEFAULTS["BENCH_SERVE_SPEC_K"]))
+    draft_layers = max(int(os.environ.get(
+        "HVD_SERVE_DRAFT_LAYERS",
+        KNOB_DEFAULTS["HVD_SERVE_DRAFT_LAYERS"])), 1)
+    spec_adapter = TransformerAdapter(cfg, params, max_len=kernel_len,
+                                      block_tokens=block_tokens,
+                                      draft_layers=draft_layers)
+
+    def spec_storm(sk):
+        mk = lambda: InferenceEngine(  # noqa: E731
+            spec_adapter, max_batch=4, kv_mode="paged",
+            prefill_chunk=chunk, prefix_cache=False,
+            metrics=ServeMetrics(), replica_id=f"bench-spec{sk}",
+            spec_k=sk)
+        if not smoke:
+            # Warm pass compiles this config's buckets outside the timed
+            # window; the smoke run (exactness/contract only — the
+            # compile caches live on the shared adapter anyway) skips it.
+            warm = mk().start()
+            engine_storm(warm, kernel_prompts, kernel_tokens)
+            warm.stop()
+        eng = mk().start()
+        eng.metrics.started_at = time.monotonic()
+        t0_ = time.perf_counter()
+        outs_ = engine_storm(eng, kernel_prompts, kernel_tokens)
+        dt_ = time.perf_counter() - t0_
+        snap_ = eng.metrics.snapshot()
+        eng.stop()
+        return outs_, dt_, snap_
+
+    spec_base_outs, spec_base_dt, _ = spec_storm(0)
+    spec_outs, spec_dt, spec_snap = spec_storm(spec_k)
+    spec_emitted = sum(len(o) for o in spec_outs) - len(kernel_prompts)
+    spec_accepted = spec_snap["spec"]["accepted"]
+    arm_spec = {
+        "spec_k": spec_k,
+        "draft_layers": draft_layers,
+        "outputs_match": spec_outs == spec_base_outs,
+        "acceptance_rate": spec_snap["spec"]["acceptance_rate"],
+        "drafted": spec_snap["spec"]["drafted"],
+        "accepted": spec_accepted,
+        "rejected": spec_snap["spec"]["rejected"],
+        "spec_steps": spec_snap["spec"]["steps"],
+        "target_calls_per_token": round(
+            (spec_emitted - spec_accepted) / max(spec_emitted, 1), 4),
+        "baseline_tokens_per_sec": round(
+            sum(len(o) for o in spec_base_outs) / spec_base_dt, 2),
+        "tokens_per_sec": round(
+            sum(len(o) for o in spec_outs) / spec_dt, 2),
+        "speedup": round(
+            (sum(len(o) for o in spec_outs) / spec_dt)
+            / max(sum(len(o) for o in spec_base_outs)
+                  / spec_base_dt, 1e-9), 3),
+    }
+
+    # -- arm 7: seeded sampling + CoW-forked n-best (ISSUE 11) ----------------
+    # Determinism: the identical sampled storm (per-request fixed seeds,
+    # temperature/top_k from the knobs) on two fresh engines must produce
+    # identical outputs — the batched==single-given-the-same-key contract
+    # at storm concurrency.  n-best: one n=4 request against one n=1
+    # request at the same prompt length on fresh pools; the fork family
+    # shares the full prompt blocks, so its peak pool footprint must sit
+    # STRICTLY below 4x the single sequence's (the CoW acceptance bar).
+    sample_temp = float(os.environ.get(
+        "BENCH_SERVE_SAMPLE_TEMP",
+        KNOB_DEFAULTS["BENCH_SERVE_SAMPLE_TEMP"]))
+    sample_seeds = [9000 + i for i in range(len(kernel_prompts))]
+
+    def sampled_storm():
+        eng = InferenceEngine(spec_adapter, max_batch=4, kv_mode="paged",
+                              prefill_chunk=chunk, prefix_cache=False,
+                              metrics=ServeMetrics(),
+                              replica_id="bench-sampled").start()
+        reqs = [Request(p, max_new_tokens=kernel_tokens,
+                        temperature=sample_temp, top_k=64, seed=s)
+                for p, s in zip(kernel_prompts, sample_seeds)]
+        t0_ = time.perf_counter()
+        for r in reqs:
+            eng.batcher.submit(r)
+        outs_ = [r.result(timeout=600) for r in reqs]
+        dt_ = time.perf_counter() - t0_
+        eng.stop()
+        return outs_, dt_
+
+    if not smoke:
+        sampled_storm()  # warm the sampled decode/logit-prefill buckets
+    sam1_outs, sam1_dt = sampled_storm()
+    sam2_outs, _ = sampled_storm()
+
+    nbest_prompt = rng.randint(0, 256,
+                               size=(3 * block_tokens + 5,)).tolist()
+
+    def nbest_run(n):
+        eng = InferenceEngine(spec_adapter, max_batch=8, kv_mode="paged",
+                              prefill_chunk=chunk, prefix_cache=False,
+                              metrics=ServeMetrics(),
+                              replica_id=f"bench-nbest{n}").start()
+        req = Request(nbest_prompt, max_new_tokens=kernel_tokens,
+                      temperature=sample_temp, top_k=64, n=n, seed=1234)
+        eng.batcher.submit(req)
+        req.result(timeout=600)
+        kv_ = eng.kv_stats()
+        eng.stop()
+        return req, kv_
+
+    _, kv_n1 = nbest_run(1)
+    nbest_req, kv_n4 = nbest_run(4)
+    bpb = kv_n1.get("bytes_per_block", 1)
+    arm_sampling = {
+        "temperature": sample_temp,
+        "top_k": 64,
+        "deterministic": sam1_outs == sam2_outs,
+        "tokens_per_sec": round(
+            sum(len(o) for o in sam1_outs) / sam1_dt, 2),
+        "nbest_n": 4,
+        "cow_forks": kv_n4["seq_forks"],
+        "forked_requests": kv_n4["forked_requests"],
+        "cow_copies": kv_n4["cow"],
+        "n1_peak_pool_bytes": int(kv_n1["used_peak"] * bpb),
+        "n4_peak_pool_bytes": int(kv_n4["used_peak"] * bpb),
+        "pool_share_ratio": round(
+            kv_n4["used_peak"] / max(4 * kv_n1["used_peak"], 1), 4),
+        "completions_distinct": len({tuple(s)
+                                     for s in nbest_req.samples}) > 1,
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -881,6 +1033,8 @@ def bench_serve():
         "kv_dtype_arm": arm_kv_dtype,
         "faults": arm_faults,
         "trace": arm_trace,
+        "spec": arm_spec,
+        "sampling": arm_sampling,
     })
 
 

@@ -97,6 +97,18 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "collective desynchronizes ranks)",
          "count the error into metrics, log it, back off and retry "
          "(serve/replica.watch_preemption is the model), or re-raise"),
+    Rule("HVD010", ERROR,
+         "reused-or-ambient PRNG in serving code: a jax.random.PRNGKey/"
+         "fold_in inside serve/ seeded from the wall clock or a "
+         "rank/request-independent constant — clock seeds break the "
+         "replay/failover exactness contract (the same request resampled "
+         "elsewhere draws different tokens), constant seeds hand every "
+         "request the same stream (batch-position correlations the "
+         "batched==single-given-the-same-key contract forbids)",
+         "derive every serving key from the request's seed "
+         "(sampling.seq_key folds (seed, sample_index); per-token keys "
+         "fold the position) so draws are reproducible and "
+         "request-independent"),
     # -- lock-order / thread-lifecycle (hvdrace static) rules ---------------
     Rule("HVD200", ERROR,
          "lock-order cycle: two code paths acquire the same pair of locks "

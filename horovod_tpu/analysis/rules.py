@@ -386,6 +386,7 @@ def analyze(tree: ast.Module, path: str) -> List[Finding]:
     _rule_traced_body_calls(mod, emit)             # HVD003/4/5/8 + HVD006
     _rule_closed_over_mutation(mod, emit)          # HVD007
     _rule_swallowed_fault(mod, emit)               # HVD009
+    _rule_serve_prng(mod, emit)                    # HVD010 (serve/ only)
 
     # Dedup (nested rank-guards can flag one call twice) + stable order.
     seen, out = set(), []
@@ -650,6 +651,77 @@ def _rule_swallowed_fault(mod: _Module, emit) -> None:
                      f"metrics, back off and retry, or re-raise — a "
                      f"dropped fault here is invisible until the job "
                      f"wedges")
+
+
+# -- HVD010: reused-or-ambient PRNG in serving code -------------------------
+
+#: jax.random constructors/derivers whose seed provenance HVD010 audits.
+PRNG_KEY_FNS = {"PRNGKey", "key", "fold_in"}
+
+
+def _in_serve_tree(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/serve/" in norm or norm.startswith("serve/")
+
+
+def _clock_derived(node: ast.AST) -> Optional[str]:
+    """The dotted clock/date call feeding ``node``, if any — a PRNG key
+    derived from the wall clock differs per replica and per replay."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        dotted = _dotted(sub.func)
+        if not dotted:
+            continue
+        if _clock_call(dotted) is not None:
+            return dotted
+        last = dotted.split(".")[-1]
+        if last in DATETIME_FNS and "datetime" in dotted.split(".")[:-1]:
+            return dotted
+    return None
+
+
+def _rule_serve_prng(mod: _Module, emit) -> None:
+    """HVD010: serve-aware PRNG provenance (the serving sharpening of
+    HVD003's unseeded-randomness concern).  Inside ``serve/``, a
+    ``jax.random.PRNGKey``/``key``/``fold_in`` call whose seed derives
+    from the wall clock (replay/failover divergence) or is a literal
+    constant (every request shares the stream — ambient, rank- and
+    request-independent) is flagged; keys must chain from the request
+    seed (sampling.seq_key) so batched == single given the same key
+    survives requeue, failover, and fork."""
+    if not _in_serve_tree(mod.path):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        parts = dotted.split(".") if dotted else []
+        last = parts[-1] if parts else ""
+        if last not in PRNG_KEY_FNS or not node.args:
+            continue
+        # Only jax.random-shaped chains: a dotted base must mention
+        # ``random`` (jax.random.PRNGKey, _random.fold_in); bare names
+        # cover ``from jax.random import ...``.  ``PRNGKey`` is
+        # unambiguous under any base.  This keeps dict.key()-style
+        # calls out.
+        if len(parts) > 1 and last != "PRNGKey" and \
+                not any("random" in p for p in parts[:-1]):
+            continue
+        clock = _clock_derived(node)
+        if clock is not None:
+            emit("HVD010", node,
+                 f"'{last}' seeds serving randomness from the wall clock "
+                 f"('{clock}'): a resubmitted/replayed request draws "
+                 f"different tokens on every replica")
+            continue
+        seed_args = (node.args[:1] if last != "fold_in"
+                     else node.args[:2])
+        if all(isinstance(a, ast.Constant) for a in seed_args):
+            emit("HVD010", node,
+                 f"'{last}' builds a serving key from constant(s) only — "
+                 f"every request (and every rank) draws the same stream; "
+                 f"derive it from the request seed (sampling.seq_key)")
 
 
 # -- HVD007: mutation of closed-over state in traced code -------------------

@@ -9,8 +9,8 @@ critical-path summary.
 Exit contract: 0 merged, 1 no shards found / unreadable dir, 2 usage
 (argparse).  The merged file is a Chrome-trace JSON array openable in
 Perfetto / chrome://tracing; the summary prints one line per request
-(queue / prefill / decode / retry milliseconds, replicas crossed, retry
-counts) — the latency decomposition ROADMAP item 4's autoscaler
+(queue / prefill / decode / spec / retry milliseconds, replicas
+crossed, retry counts) — the latency decomposition ROADMAP item 4's autoscaler
 consumes in histogram form from ``/metrics``.
 """
 

@@ -52,6 +52,9 @@ from .engine import (  # noqa: F401
     InferenceEngine, MLPAdapter, ModelAdapter, TransformerAdapter,
 )
 from .metrics import Histogram, ServeMetrics  # noqa: F401
+from .sampling import (  # noqa: F401
+    filtered_probs, sample_host, seq_key, token_key, validate_params,
+)
 from .paged_attention import (  # noqa: F401
     KV_DTYPES, dequantize_kv, kv_bytes_per_token, paged_attention_reference,
     paged_decode_attention, paged_prefill_attention, quantize_kv,

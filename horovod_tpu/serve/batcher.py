@@ -63,14 +63,32 @@ class Request:
     Completion is a per-request event: HTTP handler threads block in
     ``result()`` while engine threads call ``complete``/``fail``.  A
     request drained off a dead replica is *resubmitted* — generated
-    tokens are discarded and it restarts cleanly elsewhere; greedy
-    decoding makes the eventual answer identical (tests pin this).
+    tokens are discarded and it restarts cleanly elsewhere; the
+    position-keyed decoding contract (greedy argmax, and sampled draws
+    keyed by (seed, sample, position) — serve/sampling.py) makes the
+    eventual answer identical (tests pin this).
+
+    Sampling fields (docs/serving.md): ``temperature`` 0 = greedy (the
+    default), ``top_k``/``top_p`` filter the sampled distribution,
+    ``n`` > 1 asks for n parallel completions forked off one prompt
+    prefill (CoW block tables), ``seed`` keys every draw and is always
+    echoed in the response (server-assigned when absent) so sampled
+    outputs are reproducible.  Validation is strict per field
+    (sampling.validate_params; the server maps ValueError to HTTP 400).
     """
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  eos_id: Optional[int] = None,
                  timeout_s: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: float = 1.0,
+                 n: int = 1,
+                 seed: Optional[int] = None):
+        from .sampling import validate_params
+        (self.temperature, self.top_k, self.top_p, self.n,
+         self.seed) = validate_params(temperature, top_k, top_p, n, seed)
         if not prompt:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
@@ -92,6 +110,12 @@ class Request:
         self.deadline = (self.submitted_at + timeout_s
                          if timeout_s else None)
         self.generated: List[int] = []
+        # n>1 parallel sampling: one completed token list per sample
+        # index, filled by the engine as forks finish; ``generated``
+        # mirrors sample 0 at completion (the legacy single-sample
+        # surface).  None for n == 1.
+        self.samples: Optional[List[Optional[List[int]]]] = (
+            [None] * self.n if self.n > 1 else None)
         self.replica_id: Optional[str] = None
         self.requeues = 0
         self.first_token_at: Optional[float] = None
@@ -120,7 +144,8 @@ class Request:
         # per-stage inputs ROADMAP item 4's autoscaler consumes).
         # Always on: the cost is one clock read per boundary.
         self.stage_ms: Dict[str, float] = {"queue": 0.0, "prefill": 0.0,
-                                           "decode": 0.0, "retry": 0.0}
+                                           "decode": 0.0, "spec": 0.0,
+                                           "retry": 0.0}
         self._stage_mark = self.submitted_at
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
@@ -165,6 +190,12 @@ class Request:
     @property
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def sampled(self) -> bool:
+        """True when this request draws from the sampled distribution
+        (greedy requests never touch a PRNG key)."""
+        return self.temperature > 0
 
 
 def prompt_bucket(length: int, *, floor: Optional[int] = None,

@@ -87,6 +87,11 @@ class BlockManager:
         self.evictions = 0
         self.prefix_hit_tokens = 0
         self.prefix_lookup_tokens = 0
+        # High-water mark of referenced blocks — with bytes_per_block
+        # this is the pool's peak HBM footprint, which is what makes
+        # n>1 prompt-block sharing measurable (an n-way fork's peak must
+        # sit strictly below n independent sequences').
+        self.used_peak = 0
 
     # -- sizing ---------------------------------------------------------------
 
@@ -120,7 +125,13 @@ class BlockManager:
                 bid = self._free.popleft()
                 self._ref[bid] = 1
                 out.append(bid)
+            self._note_used_locked()
             return out
+
+    def _note_used_locked(self) -> None:
+        used = self.num_blocks - len(self._free) - len(self._retained)
+        if used > self.used_peak:
+            self.used_peak = used
 
     def _evict_retained_locked(self) -> int:
         """Evict the LRU retained block (caller holds the lock):
@@ -142,6 +153,7 @@ class BlockManager:
         if self._ref[block_id] == 0:
             self._retained.pop(block_id, None)
         self._ref[block_id] += 1
+        self._note_used_locked()
 
     def free(self, block_id: int) -> None:
         """Drop one reference.  A registered block with no references is
@@ -271,6 +283,7 @@ class BlockManager:
                 "free": free,
                 "retained": retained,
                 "used": self.num_blocks - free - retained,
+                "used_peak": self.used_peak,
                 "cow": self.cow_copies,
                 "evictions": self.evictions,
                 "prefix_hit_tokens": self.prefix_hit_tokens,

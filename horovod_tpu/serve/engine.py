@@ -64,6 +64,7 @@ from ..faultline import runtime as _faultline
 from ..faultline.plan import FaultInjected
 from ..obs import tracing as _obs
 from ..utils import get_logger
+from . import sampling as _sampling
 from .batcher import (DeadlineExceededError, DynamicBatcher, Request,
                       bucket_requests, prompt_bucket)
 from .blocks import BlockManager, NoFreeBlocksError, chain_hashes
@@ -154,7 +155,8 @@ class TransformerAdapter(ModelAdapter):
     def __init__(self, cfg, params, max_len: Optional[int] = None,
                  block_tokens: Optional[int] = None,
                  attn_impl: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 draft_layers: Optional[int] = None):
         import jax.numpy as jnp
         if cfg.seq_parallel is not None or cfg.moe_experts:
             raise ValueError(
@@ -205,13 +207,37 @@ class TransformerAdapter(ModelAdapter):
             else {"int8": jnp.int8,
                   "fp8": getattr(jnp, "float8_e4m3fn", None)}[kvd])
         self._scale_dtype = SCALE_DTYPE
+        # Speculative-decoding draft: the first ``draft_layers`` blocks
+        # + the final LN/LM-head run as a cheap proposer that SHARES the
+        # target's params and KV pool — the draft's layer-l K/V at a
+        # verified position is the same math the target writes there, so
+        # the draft needs no cache of its own and a rejected draft
+        # leaves nothing to reconcile (the verify step rewrites the same
+        # positions for all layers).  0 disables (spec_capable False).
+        dl = (draft_layers if draft_layers is not None
+              else int(os.environ.get("HVD_SERVE_DRAFT_LAYERS", "0")))
+        if not 0 <= dl < self.num_layers:
+            raise ValueError(
+                f"draft_layers must be in [0, num_layers), got {dl} "
+                f"(num_layers {self.num_layers})")
+        self.draft_layers = dl
         self._prefill_cache: Dict[Tuple[int, int], object] = {}
         self._chunk_cache: Dict[Tuple[int, int, int], object] = {}
+        self._chunk_logits_cache: Dict[Tuple[int, int, int], object] = {}
+        self._verify_cache: Dict[Tuple[int, int, int], object] = {}
         self._decode_fns: Dict[int, object] = {}
         self._paged_decode_fns: Dict[Tuple[int, int], object] = {}
+        self._sampled_decode_fns: Dict[Tuple[int, int], object] = {}
+        self._draft_decode_fns: Dict[Tuple[int, int], object] = {}
         self._copy_block_fn = None
         self._max_batch = None
         self._num_blocks = None
+
+    @property
+    def spec_capable(self) -> bool:
+        """True when this adapter can serve speculative decoding (a
+        draft stack is configured — HVD_SERVE_DRAFT_LAYERS >= 1)."""
+        return self.draft_layers > 0
 
     # -- trace-time analysis (HVD_ANALYZE=1) ---------------------------------
 
@@ -454,9 +480,25 @@ class TransformerAdapter(ModelAdapter):
         storage dtypes): scatter each chunk's (possibly quantized) K/V
         into the pool, attend over the block tables, return ``(pool,
         final-position logits)``.  Shared by the jitted per-bucket
-        programs (argmax on top) and ``prompt_logits`` (the bench/test
-        logit-error probe — quantization error must be measured through
-        the REAL storage path, not a simulation of it)."""
+        programs (argmax on top), the logits/verify variants (sampling
+        + speculative decoding need raw logits) and ``prompt_logits``
+        (the bench/test logit-error probe — quantization error must be
+        measured through the REAL storage path, not a simulation of
+        it)."""
+        import jax.numpy as jnp
+        pool, x = self._chunk_body(params, cache, tokens, starts,
+                                   lengths, tables, NB, c)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return pool, self._logits(last, params)
+
+    def _chunk_body(self, params, cache, tokens, starts, lengths,
+                    tables, NB: int, c: int):
+        """Scatter + attend for one chunk batch; returns ``(pool, x)``
+        with ``x`` the final hidden states at EVERY chunk position —
+        ``_chunk_forward`` reads only each row's last position, the
+        speculative ``verify_chunk`` reads all of them."""
         import jax.numpy as jnp
         BT = self.block_tokens
         MB = self.max_blocks_per_seq
@@ -491,10 +533,7 @@ class TransformerAdapter(ModelAdapter):
             # chunks / cached prefix blocks (both impls).
             out = self._paged_attend(q, pool, l, tables, starts)
             x = self._ffn(self._proj(x, out, blk), blk)
-        last = jnp.take_along_axis(
-            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-        )[:, 0]
-        return pool, self._logits(last, params)
+        return pool, x
 
     def _build_prefill_chunk(self, n: int, c: int, NB: int):
         import jax
@@ -537,21 +576,33 @@ class TransformerAdapter(ModelAdapter):
         ``tables[i]``.  Returns ``(cache, next_tokens)``; the engine uses
         ``next_tokens[i]`` only when the chunk completes its prompt (the
         argmax at each chunk's last position)."""
+        key, call_args = self._pack_chunk_args(cache, chunks, starts,
+                                               tables)
+        if key not in self._chunk_cache:
+            self._chunk_cache[key] = self._build_prefill_chunk(*key)
+        self._maybe_analyze("prefill_chunk", key, self._chunk_cache[key],
+                            call_args)
+        cache, nxt = self._chunk_cache[key](*call_args)
+        return cache, np.asarray(nxt)[:len(chunks)]
+
+    def _pack_chunk_args(self, cache, chunks, starts, tables):
+        """Shared bucketing + padding for the chunk-program family
+        (prefill_chunk / prefill_chunk_logits / verify_chunk): returns
+        ``(compile_key, call_args)`` — ONE home for the (count,
+        chunk-len, pool-geometry) keying discipline, so the family can
+        never compile under inconsistent keys.  Pool geometry comes from
+        the CACHE ARGUMENT, never from a mutable adapter attribute, and
+        is part of the compile key: the traced program bakes the OOB
+        hole sentinel (= num_blocks) into its closure, and an adapter is
+        shareable across engines with different pool sizes (even
+        interleaved) — a stale sentinel would silently scatter pad-tail
+        K/V into a REAL block."""
         import jax.numpy as jnp
         n_bucket = _next_pow2(len(chunks))
         max_c = max(len(ch) for ch in chunks)
         c_bucket = prompt_bucket(max_c, cap=self.max_len)
-        # Pool geometry comes from the CACHE ARGUMENT, never from a
-        # mutable adapter attribute, and is part of the compile key: the
-        # traced program bakes the OOB hole sentinel (= num_blocks) into
-        # its closure, and an adapter is shareable across engines with
-        # different pool sizes (even interleaved) — a stale sentinel
-        # would silently scatter pad-tail K/V into a REAL block.
         NB = int(cache["k"].shape[1])
         key = (n_bucket, c_bucket, NB)
-        if key not in self._chunk_cache:
-            self._chunk_cache[key] = self._build_prefill_chunk(
-                n_bucket, c_bucket, NB)
         MB = self.max_blocks_per_seq
         tok = np.zeros((n_bucket, c_bucket), np.int32)
         st = np.zeros((n_bucket,), np.int32)
@@ -562,12 +613,64 @@ class TransformerAdapter(ModelAdapter):
             st[i] = s0
             ln[i] = len(ch)
             tab[i, :len(t)] = t
-        call_args = (self.params, cache, jnp.asarray(tok), jnp.asarray(st),
-                     jnp.asarray(ln), jnp.asarray(tab))
-        self._maybe_analyze("prefill_chunk", key, self._chunk_cache[key],
+        return key, (self.params, cache, jnp.asarray(tok),
+                     jnp.asarray(st), jnp.asarray(ln), jnp.asarray(tab))
+
+    def _build_prefill_chunk_logits(self, n: int, c: int, NB: int):
+        import jax
+
+        def fn(params, cache, tokens, starts, lengths, tables):
+            return self._chunk_forward(params, cache, tokens, starts,
+                                       lengths, tables, NB, c)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def prefill_chunk_logits(self, cache, chunks, starts, tables):
+        """``prefill_chunk`` returning each row's final-position LM
+        logits instead of their argmax — the sampled / n>1 first-token
+        path: the engine draws the first generated token(s) on the host
+        (an n-way fork needs n draws from ONE logit row, each with its
+        own sample key).  Greedy batches keep the token-only program —
+        this variant only runs when a sampled or forked request is in
+        the chunk batch."""
+        key, call_args = self._pack_chunk_args(cache, chunks, starts,
+                                               tables)
+        if key not in self._chunk_logits_cache:
+            self._chunk_logits_cache[key] = \
+                self._build_prefill_chunk_logits(*key)
+        self._maybe_analyze("prefill_chunk_logits", key,
+                            self._chunk_logits_cache[key], call_args)
+        cache, logits = self._chunk_logits_cache[key](*call_args)
+        return cache, np.asarray(logits)[:len(chunks)]
+
+    def _build_verify_chunk(self, n: int, c: int, NB: int):
+        import jax
+
+        def fn(params, cache, tokens, starts, lengths, tables):
+            pool, x = self._chunk_body(params, cache, tokens, starts,
+                                       lengths, tables, NB, c)
+            return pool, self._logits(x, params)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def verify_chunk(self, cache, chunks, starts, tables):
+        """Speculative verify: run ``chunks[i]`` (the row's last emitted
+        token + its k drafted tokens) through the FULL model in one
+        multi-token step — the chunked-prefill machinery with
+        per-sequence positions — scattering their K/V and returning the
+        LM logits at EVERY chunk position ``[n, c, V]``.  ``logits[i,
+        j]`` is the target distribution for the token at absolute
+        position ``starts[i] + j + 1``; the engine accepts a drafted
+        prefix against it and resamples the first rejection
+        (docs/serving.md speculative decoding)."""
+        key, call_args = self._pack_chunk_args(cache, chunks, starts,
+                                               tables)
+        if key not in self._verify_cache:
+            self._verify_cache[key] = self._build_verify_chunk(*key)
+        self._maybe_analyze("verify_chunk", key, self._verify_cache[key],
                             call_args)
-        cache, nxt = self._chunk_cache[key](*call_args)
-        return cache, np.asarray(nxt)[:len(chunks)]
+        cache, logits = self._verify_cache[key](*call_args)
+        return cache, np.asarray(logits)[:len(chunks)]
 
     # -- decode (slot mode) --------------------------------------------------
 
@@ -623,39 +726,52 @@ class TransformerAdapter(ModelAdapter):
 
     # -- decode (paged mode) -------------------------------------------------
 
+    def _paged_step_body(self, params, cache, tokens, positions, tables,
+                         num_layers: int):
+        """ONE home for the single-token paged decode forward (embed →
+        per-layer scatter/attend/ffn → LM logits), traceable.  The three
+        decode builders (greedy / in-jit sampled / truncated-stack
+        draft) wrap this with their own head, so the hole-clamp table
+        lookup, the quantized-scatter branch, and the position clamp can
+        never diverge between them.
+
+        tokens [B]; positions [B] (cache index this token's K/V lands
+        at); tables [B, MB] block tables (entry NB for holes and
+        inactive rows — scatter drops, the attention clamps + masks; NB
+        is baked per pool geometry via the compile key).  Returns
+        ``(pool, logits[B, V])``."""
+        import jax.numpy as jnp
+        BT, MB = self.block_tokens, self.max_blocks_per_seq
+        pos = jnp.minimum(positions, self.max_len - 1)
+        x = params["wte"]["embedding"][tokens] \
+            + params["wpe"]["embedding"][pos]  # [B, d]
+        pool = dict(cache)
+        wblk = jnp.take_along_axis(
+            tables, jnp.minimum(pos // BT, MB - 1)[:, None],
+            axis=1)[:, 0]                             # [B]
+        woff = pos % BT
+        for l in range(num_layers):
+            blk = params[f"block_{l}"]
+            q, k, v = self._qkv(x, blk)               # [B, H, Dh]
+            if self._kv_quantized:
+                pool = self._quantized_scatter(pool, l, wblk, woff,
+                                               k, v)
+            else:
+                pool["k"] = pool["k"].at[l, wblk, woff].set(
+                    k.astype(self._kv_store_dtype))
+                pool["v"] = pool["v"].at[l, wblk, woff].set(
+                    v.astype(self._kv_store_dtype))
+            out = self._paged_attend(q, pool, l, tables, pos)
+            x = self._ffn(self._proj(x, out, blk), blk)
+        return pool, self._logits(x, params)
+
     def _build_paged_decode(self, B: int):
         import jax
         import jax.numpy as jnp
-        L = self.num_layers
-        BT, MB = self.block_tokens, self.max_blocks_per_seq
 
         def fn(params, cache, tokens, positions, tables):
-            # tokens [B]; positions [B] (cache index this token's K/V
-            # lands at); tables [B, MB] block tables (entry NB for holes
-            # and inactive rows — scatter drops, the attention clamps +
-            # masks; NB is baked per pool geometry via the compile key).
-            pos = jnp.minimum(positions, self.max_len - 1)
-            x = params["wte"]["embedding"][tokens] \
-                + params["wpe"]["embedding"][pos]  # [B, d]
-            pool = dict(cache)
-            wblk = jnp.take_along_axis(
-                tables, jnp.minimum(pos // BT, MB - 1)[:, None],
-                axis=1)[:, 0]                             # [B]
-            woff = pos % BT
-            for l in range(L):
-                blk = params[f"block_{l}"]
-                q, k, v = self._qkv(x, blk)               # [B, H, Dh]
-                if self._kv_quantized:
-                    pool = self._quantized_scatter(pool, l, wblk, woff,
-                                                   k, v)
-                else:
-                    pool["k"] = pool["k"].at[l, wblk, woff].set(
-                        k.astype(self._kv_store_dtype))
-                    pool["v"] = pool["v"].at[l, wblk, woff].set(
-                        v.astype(self._kv_store_dtype))
-                out = self._paged_attend(q, pool, l, tables, pos)
-                x = self._ffn(self._proj(x, out, blk), blk)
-            logits = self._logits(x, params)
+            pool, logits = self._paged_step_body(
+                params, cache, tokens, positions, tables, self.num_layers)
             return pool, jnp.argmax(logits, axis=-1)
 
         return jax.jit(fn, donate_argnums=(1,))
@@ -675,6 +791,89 @@ class TransformerAdapter(ModelAdapter):
         self._maybe_analyze("decode_paged", key,
                             self._paged_decode_fns[key], call_args)
         cache, nxt = self._paged_decode_fns[key](*call_args)
+        return cache, np.asarray(nxt)
+
+    def _build_paged_decode_sampled(self, B: int):
+        """The paged decode program with in-jit seeded sampling: same
+        forward as ``_build_paged_decode``, but the LM logits feed
+        ``sampling.sample_batched`` with per-row base keys + sampling
+        params as traced operands — one program per (pool, batch)
+        geometry regardless of the request mix, and rows with
+        temperature 0 return the argmax bit-identically to the greedy
+        program."""
+        import jax
+        from . import sampling as _sampling
+
+        def fn(params, cache, tokens, positions, tables, keys, temps,
+               top_ks, top_ps):
+            pool, logits = self._paged_step_body(
+                params, cache, tokens, positions, tables, self.num_layers)
+            # The token this step emits OCCUPIES position fed+1 — the
+            # fold value of its key (sampling.py module doc).
+            toks = _sampling.sample_batched(
+                logits, keys, positions + 1, temps, top_ks, top_ps)
+            return pool, toks
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def decode_paged_sampled(self, cache, tokens, positions, tables,
+                             keys, temps, top_ks, top_ps):
+        """One sampled token step for the whole batch (see
+        ``_build_paged_decode_sampled``); greedy-only batches keep
+        ``decode_paged``."""
+        import jax.numpy as jnp
+        key = (int(cache["k"].shape[1]), len(tokens))
+        if self._sampled_decode_fns.get(key) is None:
+            self._sampled_decode_fns[key] = \
+                self._build_paged_decode_sampled(len(tokens))
+        call_args = (self.params, cache, jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(positions, jnp.int32),
+                     jnp.asarray(tables, jnp.int32),
+                     jnp.asarray(keys, jnp.uint32),
+                     jnp.asarray(temps, jnp.float32),
+                     jnp.asarray(top_ks, jnp.int32),
+                     jnp.asarray(top_ps, jnp.float32))
+        self._maybe_analyze("decode_sampled", key,
+                            self._sampled_decode_fns[key], call_args)
+        cache, nxt = self._sampled_decode_fns[key](*call_args)
+        return cache, np.asarray(nxt)
+
+    def _build_draft_decode(self, B: int):
+        """The truncated-stack draft step: blocks ``0..draft_layers-1``
+        + the final LN / tied LM head, writing draft K/V into the SAME
+        pool (layers 0..draft_layers-1 only).  Proposals are the
+        draft's argmax — a point-mass q, which keeps rejection
+        sampling exact (sampling.residual_sample) without shipping
+        draft distributions to the host."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(params, cache, tokens, positions, tables):
+            pool, logits = self._paged_step_body(
+                params, cache, tokens, positions, tables,
+                self.draft_layers)
+            return pool, jnp.argmax(logits, axis=-1)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def draft_decode(self, cache, tokens, positions, tables):
+        """One draft proposal step (see ``_build_draft_decode``)."""
+        import jax.numpy as jnp
+        if not self.spec_capable:
+            raise ValueError(
+                "no draft stack configured: set HVD_SERVE_DRAFT_LAYERS "
+                ">= 1 (or pass draft_layers=) to enable speculative "
+                "decoding")
+        key = (int(cache["k"].shape[1]), len(tokens))
+        if self._draft_decode_fns.get(key) is None:
+            self._draft_decode_fns[key] = self._build_draft_decode(
+                len(tokens))
+        call_args = (self.params, cache, jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(positions, jnp.int32),
+                     jnp.asarray(tables, jnp.int32))
+        self._maybe_analyze("draft_decode", key,
+                            self._draft_decode_fns[key], call_args)
+        cache, nxt = self._draft_decode_fns[key](*call_args)
         return cache, np.asarray(nxt)
 
     def copy_block(self, cache, src: int, dst: int):
@@ -708,20 +907,41 @@ class MLPAdapter(ModelAdapter):
     token is ``argmax(MLP(one_hot(token)))`` — a deterministic Markov
     chain over the vocab, so batching/requeue/parity logic is exercised
     without transformer compile cost.  Serves in both modes: its paged
-    interface consumes zero blocks (``kv_token_cost = 0``)."""
+    interface consumes zero blocks (``kv_token_cost = 0``).  Sampling
+    draws from ``softmax(MLP(one_hot(token)))`` through the same keyed
+    sampler as the transformer, and the spec draft is the model ITSELF
+    (``draft_decode`` == greedy decode): a perfect proposer, which is
+    what lets the bench's spec arm measure pure amortization
+    (target calls per token → 1/(k+1)) without draft-quality noise."""
 
     kv_token_cost = 0
     block_tokens = 1
     max_blocks_per_seq = 0
+    spec_capable = True
 
     def __init__(self, mlp, params, vocab_size: int, max_len: int = 1024):
         import jax
+        import jax.numpy as jnp
+        from . import sampling as _sampling
         self.vocab_size = vocab_size
         self.max_len = max_len
+        self._logits_of = jax.jit(
+            lambda tokens: mlp.apply(
+                {"params": params},
+                jax.nn.one_hot(tokens, vocab_size)).astype(jnp.float32))
         self._apply = jax.jit(
             lambda tokens: jax.numpy.argmax(
                 mlp.apply({"params": params},
                           jax.nn.one_hot(tokens, vocab_size)), axis=-1))
+
+        def _sampled(tokens, keys, positions, temps, top_ks, top_ps):
+            logits = mlp.apply({"params": params},
+                               jax.nn.one_hot(tokens, vocab_size)
+                               ).astype(jnp.float32)
+            return _sampling.sample_batched(logits, keys, positions + 1,
+                                            temps, top_ks, top_ps)
+
+        self._sampled_step = jax.jit(_sampled)
 
     def init_cache(self, max_batch: int):
         return ()
@@ -739,10 +959,43 @@ class MLPAdapter(ModelAdapter):
         last = np.asarray([ch[-1] for ch in chunks], np.int32)
         return cache, np.asarray(self._apply(last))
 
+    def prefill_chunk_logits(self, cache, chunks, starts, tables):
+        last = np.asarray([ch[-1] for ch in chunks], np.int32)
+        return cache, np.asarray(self._logits_of(last))
+
+    def verify_chunk(self, cache, chunks, starts, tables):
+        # Markov chain: logits at chunk position j depend only on the
+        # chunk token at j — one batched apply over the flattened
+        # [n*c] token block (the MLP folds non-batch dims) gives every
+        # position's target distribution.
+        n, c = len(chunks), max(len(ch) for ch in chunks)
+        tok = np.zeros((n, c), np.int32)
+        for i, ch in enumerate(chunks):
+            tok[i, :len(ch)] = ch
+        flat = np.asarray(self._logits_of(tok.reshape(-1)))
+        return cache, flat.reshape(n, c, self.vocab_size)
+
     def decode(self, cache, tokens, positions):
         return cache, np.asarray(self._apply(np.asarray(tokens, np.int32)))
 
     def decode_paged(self, cache, tokens, positions, tables):
+        return self.decode(cache, tokens, positions)
+
+    def decode_paged_sampled(self, cache, tokens, positions, tables,
+                             keys, temps, top_ks, top_ps):
+        import jax.numpy as jnp
+        nxt = self._sampled_step(
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32))
+        return cache, np.asarray(nxt)
+
+    def draft_decode(self, cache, tokens, positions, tables):
+        # The draft IS the target (perfect proposer): greedy spec then
+        # accepts every draft and the engine's amortization machinery is
+        # exercised at its theoretical ceiling.
         return self.decode(cache, tokens, positions)
 
 
@@ -760,9 +1013,18 @@ class _Slot:
 
 
 class _Seq:
-    """Paged-mode sequence state."""
+    """Paged-mode sequence state.
+
+    ``generated`` is the authoritative token list for THIS sequence: for
+    a plain n==1 request it IS ``request.generated`` (the same list
+    object — every legacy surface keeps working), for an n>1 fork it is
+    the fork's own stream, copied into ``request.samples[sample_index]``
+    at retirement.  ``parked`` marks a fork slot reserved at admission
+    but not yet activated (the prompt is still prefilling through the
+    group's primary sequence)."""
     __slots__ = ("request", "length", "prompt_pos", "table", "hashes",
-                 "admit_seq", "published")
+                 "admit_seq", "published", "generated", "group",
+                 "sample_index", "base_key", "parked")
 
     def __init__(self, request: Request, cached_tokens: int,
                  table: List[int], hashes: List[int], admit_seq: int):
@@ -773,10 +1035,44 @@ class _Seq:
         self.hashes = hashes             # prompt full-block chain hashes
         self.admit_seq = admit_seq       # admission order (preempt youngest)
         self.published = 0               # prefix-registered block watermark
+        self.generated = request.generated  # n>1 members get own lists
+        self.group: Optional[_ForkGroup] = None
+        self.sample_index = 0
+        self.base_key = None             # uint32[2] seq key (sampled only)
+        self.parked = False              # reserved fork slot, pre-activation
 
     @property
     def decoding(self) -> bool:
-        return self.prompt_pos >= len(self.request.prompt)
+        return not self.parked and self.prompt_pos >= len(self.request.prompt)
+
+
+class _ForkGroup:
+    """One n>1 request's fork family: the primary (sample 0) prefills
+    the prompt once; at prompt completion the group forks — every member
+    maps the shared full prompt blocks through its own CoW block table
+    and decodes independently.  The request completes when the LAST
+    member retires; preemption/expiry/drain treat the family as one unit
+    (half a request can never be requeued).
+
+    ``reserve`` is the family's not-yet-allocated worst-case decode
+    footprint — the (n-1) fork tails admission COUNTED in its budget
+    but did not allocate (the forks grow into them at decode time:
+    the CoW copy of the shared partial prompt block plus each fork's
+    decode blocks).  ``_admit_paged`` subtracts the live groups'
+    reserves from the pool budget so a later admission round can never
+    hand those blocks to someone else — which would turn preemption
+    from a defensive path into a steady-state tax on every n>1
+    request; each fork-side allocation consumes one unit."""
+    __slots__ = ("request", "seqs", "completed", "forked", "reserve",
+                 "reserve_cap")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.seqs: List[_Seq] = []
+        self.completed = 0
+        self.forked = False
+        self.reserve = 0
+        self.reserve_cap = 0  # admission-time value; refunds never exceed it
 
 
 class InferenceEngine:
@@ -797,7 +1093,8 @@ class InferenceEngine:
                  kv_mode: Optional[str] = None,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 spec_k: Optional[int] = None):
         self.adapter = adapter
         self.max_batch = max_batch if max_batch is not None else int(
             os.environ.get("HVD_SERVE_MAX_BATCH", "8"))
@@ -867,6 +1164,40 @@ class InferenceEngine:
             self._cache = adapter.init_cache(self.max_batch)
             self.pool_bytes = self.weight_bytes = 0
             self.kv_headroom_bytes: Optional[int] = None
+        # Decode-algorithm layer (docs/serving.md sampling/spec): seeded
+        # sampling + n>1 forking need the logits/sampled adapter
+        # programs; speculative decoding additionally needs the
+        # draft + multi-token verify pair.  Capabilities are checked
+        # here (spec: loudly at construction) and per request at
+        # admission (_fail_doomed) so a legacy adapter keeps serving
+        # greedy n==1 exactly as before.
+        self._sample_capable = (
+            mode == "paged"
+            and hasattr(adapter, "decode_paged_sampled")
+            and hasattr(adapter, "prefill_chunk_logits"))
+        sk = (spec_k if spec_k is not None
+              else int(os.environ.get("HVD_SERVE_SPEC_K", "0")))
+        if sk < 0:
+            raise ValueError(f"spec_k must be >= 0, got {sk}")
+        if sk > 0:
+            if mode != "paged":
+                raise ValueError(
+                    "speculative decoding requires kv_mode='paged' "
+                    "(the draft shares the paged pool)")
+            if not (hasattr(adapter, "verify_chunk")
+                    and hasattr(adapter, "draft_decode")
+                    and getattr(adapter, "spec_capable", False)):
+                raise ValueError(
+                    f"{type(adapter).__name__} has no usable draft for "
+                    f"speculative decoding (verify_chunk/draft_decode + "
+                    f"spec_capable — transformer adapters need "
+                    f"HVD_SERVE_DRAFT_LAYERS >= 1)")
+        self.spec_k = sk
+        # n>1 fork observability (/metrics + kv_stats/healthz): total
+        # forked sequences created (n-1 per forked group) and requests
+        # that forked at all.
+        self.seq_forks = 0
+        self.forked_requests = 0
         self._slots: List[Optional[object]] = [None] * self.max_batch
         # Deferred trace emissions (loop-thread only): span/flow
         # emission does shard-file IO under the tracer's lock, and the
@@ -937,6 +1268,13 @@ class InferenceEngine:
         stats = self.blocks.stats()
         stats["attn_impl"] = self.attn_impl
         stats["kv_dtype"] = self.kv_dtype
+        # n>1 CoW fork + speculative config observability (ISSUE 11):
+        # sequence forks ride the same kv_stats surface as the block-
+        # level CoW copies, so /metrics + healthz show the n-best path
+        # from the first forked request.
+        stats["seq_forks"] = self.seq_forks
+        stats["forked_requests"] = self.forked_requests
+        stats["spec_k"] = self.spec_k
         # hvdmem pool-budget plan (docs/serving.md kv_headroom): the
         # pool + weight bytes this replica holds, and — when a budget is
         # known (HVD_MEM_BUDGET_BYTES / probed HBM) — the headroom left.
@@ -994,17 +1332,29 @@ class InferenceEngine:
         now = time.monotonic()
         with self._lock:
             inflight = []
+            seen = set()
             for i, s in enumerate(self._slots):
-                if s is not None:
-                    if self.blocks is not None:
-                        self.blocks.free_table(s.table)
-                    s.request.generated = []
-                    s.request.requeues += 1
-                    # Failover bookkeeping: the next admission (on the
-                    # survivor) emits the resubmission span from here.
-                    s.request.resubmitted_at = now
-                    inflight.append(s.request)
-                    self._slots[i] = None
+                if s is None:
+                    continue
+                if self.blocks is not None:
+                    self.blocks.free_table(s.table)
+                self._slots[i] = None
+                r = s.request
+                if id(r) in seen:
+                    continue  # another member of the same fork family
+                seen.add(id(r))
+                r.generated = []
+                if r.samples is not None:
+                    r.samples = [None] * r.n
+                group = getattr(s, "group", None)  # slot mode holds _Slot
+                if group is not None:
+                    group.completed = 0
+                    group.forked = False
+                r.requeues += 1
+                # Failover bookkeeping: the next admission (on the
+                # survivor) emits the resubmission span from here.
+                r.resubmitted_at = now
+                inflight.append(r)
             return inflight
 
     # -- shared helpers ------------------------------------------------------
@@ -1017,6 +1367,93 @@ class InferenceEngine:
     def _finished(r: Request, token: int) -> bool:
         return (len(r.generated) >= r.max_new_tokens
                 or (r.eos_id is not None and token == r.eos_id))
+
+    @staticmethod
+    def _seq_finished(s: "_Seq", token: int) -> bool:
+        """Per-sequence finish check (paged mode): a fork finishes on
+        its OWN stream, not the request's sample-0 mirror."""
+        r = s.request
+        return (len(s.generated) >= r.max_new_tokens
+                or (r.eos_id is not None and token == r.eos_id))
+
+    def _retire_seq(self, i: int, s: "_Seq") -> None:
+        """Free one finished sequence's slot + block refs and complete
+        its request — group-aware: an n>1 request completes when its
+        LAST fork retires (each fork's stream lands in
+        ``request.samples[sample_index]``; ``request.generated`` mirrors
+        sample 0).  Caller holds ``self._lock``."""
+        if self.blocks is not None:
+            self.blocks.free_table(s.table)
+        # The table is FREED now; clear it so group-level paths that
+        # walk ``group.seqs`` later (a pool-exhaustion preempt of a
+        # surviving member, expiry) can never free it a second time — a
+        # double free either raises or, if the block was reallocated in
+        # between, silently releases another sequence's live block.
+        s.table = []
+        self._slots[i] = None
+        r = s.request
+        if s.group is None:
+            self._complete(r)
+            return
+        r.samples[s.sample_index] = list(s.generated)
+        s.group.completed += 1
+        if s.group.completed == r.n:
+            r.generated = list(r.samples[0])
+            self._complete(r)
+
+    def _fork_group(self, s: "_Seq", logits, now: float) -> None:
+        """The fork moment of an n>1 request: its prompt K/V is fully in
+        the pool — draw every member's first token from the primary's
+        final-position ``logits`` row (each with its OWN (seed, sample)
+        key) and activate the parked forks on the shared prompt blocks.
+        This is the first real consumer of ``BlockManager``'s
+        copy-on-write path: every member maps the same physical prompt
+        blocks (one reference each), and the first divergent append into
+        the shared partial block forks a private copy
+        (``ensure_writable`` in ``_ensure_write_blocks``).  Caller holds
+        ``self._lock``."""
+        r = s.request
+        group = s.group
+        P = len(r.prompt)
+        shared = self._blocks_for_tokens(P)
+        r.first_token_at = now
+        r.stage_add("prefill", now)
+        self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
+        # observe_ttft counted sample 0's first token; the other n-1
+        # members emitted theirs in the same instant.
+        self.metrics.count_tokens(r.n - 1)
+        self.seq_forks += r.n - 1
+        self.forked_requests += 1
+        group.forked = True
+        self._defer_flow(r)
+        # Two passes: EVERY fork must take its block references before
+        # ANY member can retire — a primary finishing on its first token
+        # would otherwise free the shared prompt blocks (the unregistered
+        # partial block lands on the free list) while later forks are
+        # about to ref them, and a ref on a free-listed block aliases it
+        # with the next allocation (two sequences sharing one physical
+        # block, then a double free).
+        finished: List["_Seq"] = []
+        for f in group.seqs:
+            if f is not s:
+                f.table = list(s.table[:shared])
+                if self.blocks is not None:
+                    for bid in f.table:
+                        self.blocks.ref(bid)
+                f.length = s.length
+                f.prompt_pos = P
+                f.parked = False
+            tok = (_sampling.sample_host(
+                logits, f.base_key, P, r.temperature, r.top_k, r.top_p)
+                if r.sampled else int(np.argmax(logits)))
+            f.generated.append(tok)
+            if self._seq_finished(f, tok):
+                finished.append(f)
+        for f in finished:
+            for slot, cur in enumerate(self._slots):
+                if cur is f:
+                    self._retire_seq(slot, f)
+                    break
 
     def _flush_trace_emits(self) -> None:
         """Run deferred span/flow emissions OUTSIDE the engine lock
@@ -1130,15 +1567,33 @@ class InferenceEngine:
                 f"max_len {self.adapter.max_len}"))
             self.metrics.count_request("error")
             return True
+        # Sampling / n>1 need the logits + sampled adapter programs and
+        # the paged engine (fork tables are CoW block tables; the slot
+        # layout has nothing to fork) — fail loudly instead of silently
+        # serving a greedy single answer to a sampled n-best request.
+        if (r.sampled or r.n > 1) and not self._sample_capable:
+            r.fail(ValueError(
+                f"{r.request_id}: sampling/n>1 needs a paged engine and "
+                f"an adapter with prefill_chunk_logits/"
+                f"decode_paged_sampled (kv_mode={self.kv_mode}, "
+                f"adapter {type(self.adapter).__name__})"))
+            self.metrics.count_request("error")
+            return True
+        if r.n > self.max_batch:
+            r.fail(ValueError(
+                f"{r.request_id}: n={r.n} exceeds the engine's "
+                f"max_batch {self.max_batch} decode slots"))
+            self.metrics.count_request("error")
+            return True
         # Same cost formula as admission's cost/hard_cap (incl.
-        # kv_token_cost) — a mismatch would let _take's hard_cap bypass
-        # pop a request this check then declines to fail: an infinite
-        # requeue livelock.
+        # kv_token_cost and the n>1 shared-prompt + n-tails shape) — a
+        # mismatch would let _take's hard_cap bypass pop a request this
+        # check then declines to fail: an infinite requeue livelock.
         if self.blocks is not None and self._mb and \
-                self._blocks_for_tokens(total) > self.blocks.capacity:
+                self._request_cost_blocks(r) > self.blocks.capacity:
             r.fail(ValueError(
                 f"{r.request_id}: needs "
-                f"{self._blocks_for_tokens(total)} KV blocks but the "
+                f"{self._request_cost_blocks(r)} KV blocks but the "
                 f"pool holds {self.blocks.capacity}"))
             self.metrics.count_request("error")
             return True
@@ -1153,21 +1608,33 @@ class InferenceEngine:
         expired = 0
         now = time.monotonic()
         with self._lock:
+            failed = set()
             for i, s in enumerate(self._slots):
                 if s is None or not s.request.expired(now):
                     continue
-                s.request.fail(DeadlineExceededError(
-                    f"{s.request.request_id} deadline expired mid-flight "
-                    f"({len(s.request.generated)} token(s) generated)"))
-                self.metrics.count_request("expired")
-                if s.request.trace is not None \
-                        and _obs.TRACER is not None:
-                    def emit(t=_obs.TRACER, r=s.request, now=now,
-                             ntok=len(s.request.generated)):
-                        t.instant(r.trace, "deadline-expired",
-                                  self.replica_id,
-                                  args={"tokens": ntok}, t=now)
-                    self._trace_emits.append(emit)
+                # A fork family expires as one unit: fail/count once,
+                # free every member slot's blocks (this loop visits each
+                # member in turn — only the first fails the request).
+                if id(s.request) not in failed:
+                    failed.add(id(s.request))
+                    # Slot-mode _Slot has no per-sequence stream; the
+                    # request's own list is the authority there.
+                    gen = getattr(s, "generated", None)
+                    ntokens = len(gen if gen is not None
+                                  else s.request.generated)
+                    s.request.fail(DeadlineExceededError(
+                        f"{s.request.request_id} deadline expired "
+                        f"mid-flight ({ntokens} token(s) "
+                        f"generated)"))
+                    self.metrics.count_request("expired")
+                    if s.request.trace is not None \
+                            and _obs.TRACER is not None:
+                        def emit(t=_obs.TRACER, r=s.request, now=now,
+                                 ntok=ntokens):
+                            t.instant(r.trace, "deadline-expired",
+                                      self.replica_id,
+                                      args={"tokens": ntok}, t=now)
+                        self._trace_emits.append(emit)
                 table = getattr(s, "table", None)
                 if self.blocks is not None and table is not None:
                     self.blocks.free_table(table)
@@ -1302,6 +1769,35 @@ class InferenceEngine:
         return self.blocks.blocks_for(
             tokens * getattr(self.adapter, "kv_token_cost", 1))
 
+    def _request_cost_blocks(self, r: Request) -> int:
+        """Lifetime KV-block footprint of one request — the admission
+        cost.  n == 1: prompt + max_new positions.  n > 1: the FULL
+        prompt blocks are shared by every fork (counted once), each of
+        the n forks privately owns its tail — the partial last prompt
+        block (CoW-forked on first divergent append) plus its decode
+        region.  This is the worst case; refcounted sharing can only
+        use less (e.g. the last fork writes the partial block in
+        place)."""
+        base = self._blocks_for_tokens(len(r.prompt) + r.max_new_tokens)
+        if r.n <= 1 or not self._mb:
+            return base
+        cost = getattr(self.adapter, "kv_token_cost", 1)
+        shared_full = (len(r.prompt) * cost) // self.blocks.block_tokens
+        return base + (r.n - 1) * (base - shared_full)
+
+    def _reserved_blocks(self) -> int:
+        """Outstanding fork-tail reservations across the live fork
+        groups (each counted once) — blocks the admission budget must
+        treat as spoken-for even though they are not yet allocated."""
+        seen, total = set(), 0
+        with self._lock:
+            for s in self._slots:
+                g = getattr(s, "group", None) if s is not None else None
+                if g is not None and id(g) not in seen:
+                    seen.add(id(g))
+                    total += g.reserve
+        return total
+
     def _admit_paged(self, block_s: float) -> int:
         free = self._free_slots()
         if not free:
@@ -1311,12 +1807,16 @@ class InferenceEngine:
         # positions, so admission reserves exactly that (the paged win
         # over slot mode is not reserving max_len) — no decode-time
         # growth can exhaust the pool, so preemption stays a defensive
-        # path instead of a steady-state tax.
+        # path instead of a steady-state tax.  n>1 fork tails are
+        # reserved, not allocated (the forks grow into them at decode
+        # time), so the live groups' outstanding reserves come off the
+        # budget here.
         admitted = self.batcher.get_admission(
             len(free), block_s=block_s,
-            budget=self.blocks.available() if use_blocks else None,
-            cost=(lambda r: self._blocks_for_tokens(
-                len(r.prompt) + r.max_new_tokens)) if use_blocks else None,
+            budget=max(self.blocks.available()
+                       - self._reserved_blocks(), 0)
+            if use_blocks else None,
+            cost=self._request_cost_blocks if use_blocks else None,
             hard_cap=self.blocks.capacity if use_blocks else None)
         if not admitted:
             return 0
@@ -1325,6 +1825,14 @@ class InferenceEngine:
         for idx, r in enumerate(admitted):
             if self._fail_doomed(r):
                 continue
+            if r.n > len(free) - cursor:
+                # An n>1 request reserves its WHOLE fork family's decode
+                # slots at admission (the forks activate at prompt
+                # completion — their slots must not be stolen by a later
+                # admission in between).  Not enough left this round:
+                # put it and everything after back in order.
+                self.batcher.requeue_front(admitted[idx:])
+                break
             cached_ids: List[int] = []
             cached_tokens = 0
             hashes: List[int] = []
@@ -1354,11 +1862,42 @@ class InferenceEngine:
             seq = _Seq(r, cached_tokens, cached_ids + fresh, hashes,
                        self._admit_counter)
             self._admit_counter += 1
+            if r.sampled:
+                seq.base_key = _sampling.seq_key(r.seed, 0)
+            group: Optional[_ForkGroup] = None
+            if r.n > 1:
+                # The fork family: the primary keeps its own token list
+                # (request.generated stays the sample-0 mirror filled at
+                # completion); n-1 parked members reserve their slots
+                # now and activate at the fork moment (_fork_group).
+                # The fork tails — everything this admission COUNTED
+                # (_request_cost_blocks) beyond the primary's own
+                # lifetime — become the group's block reservation.
+                group = _ForkGroup(r)
+                if use_blocks:
+                    group.reserve = (
+                        self._request_cost_blocks(r)
+                        - self._blocks_for_tokens(
+                            len(r.prompt) + r.max_new_tokens))
+                    group.reserve_cap = group.reserve
+                seq.group = group
+                seq.generated = []
+                group.seqs.append(seq)
             r.replica_id = self.replica_id
-            slot = free[cursor]
-            cursor += 1
             with self._lock:
-                self._slots[slot] = seq
+                self._slots[free[cursor]] = seq
+                cursor += 1
+                for i in range(1, r.n):
+                    f = _Seq(r, 0, [], [], seq.admit_seq)
+                    f.group = group
+                    f.sample_index = i
+                    f.generated = []
+                    f.parked = True
+                    if r.sampled:
+                        f.base_key = _sampling.seq_key(r.seed, i)
+                    group.seqs.append(f)
+                    self._slots[free[cursor]] = f
+                    cursor += 1
         return cursor
 
     def _prefill_step(self) -> int:
@@ -1368,7 +1907,8 @@ class InferenceEngine:
         processed."""
         with self._lock:
             pending = [(i, s) for i, s in enumerate(self._slots)
-                       if s is not None and not s.decoding]
+                       if s is not None and not s.parked
+                       and not s.decoding]
         if not pending:
             return 0
         pending.sort(key=lambda t: t[1].admit_seq)
@@ -1385,9 +1925,20 @@ class InferenceEngine:
                   for _, s, take in sel]
         starts = [s.prompt_pos for _, s, _ in sel]
         tables = [list(s.table) for _, s, _ in sel]
+        # A batch containing any sampled or n>1 row runs the logits
+        # variant: first tokens are drawn on the host (an n-way fork
+        # draws n tokens from ONE logit row, each with its own sample
+        # key).  Greedy-only batches keep the token-only program — the
+        # pre-sampling fast path, bit-for-bit.
+        use_logits = self._sample_capable and any(
+            s.request.sampled or s.request.n > 1 for _, s, _ in sel)
         t0 = time.monotonic()
-        self._cache, first = self.adapter.prefill_chunk(
-            self._cache, chunks, starts, tables)
+        if use_logits:
+            self._cache, first = self.adapter.prefill_chunk_logits(
+                self._cache, chunks, starts, tables)
+        else:
+            self._cache, first = self.adapter.prefill_chunk(
+                self._cache, chunks, starts, tables)
         now = time.monotonic()
         if _obs.TRACER is not None:
             # One prefill-chunk span per TRACED sequence in this batched
@@ -1425,31 +1976,56 @@ class InferenceEngine:
                     s.published = max(s.published, s.prompt_pos // bt)
                 if not s.decoding:
                     continue
-                tok = int(tok)
                 r = s.request
+                if r.n > 1:
+                    # Fork moment: the prompt's K/V is complete — draw
+                    # every member's first token from this row's logits
+                    # and activate the parked forks on the shared
+                    # prompt blocks.
+                    self._fork_group(s, tok, now)
+                    continue
+                if use_logits:
+                    tok = (_sampling.sample_host(
+                        tok, s.base_key, len(r.prompt), r.temperature,
+                        r.top_k, r.top_p) if r.sampled
+                        else int(np.argmax(tok)))
+                else:
+                    tok = int(tok)
                 r.first_token_at = now
-                r.generated.append(tok)
+                s.generated.append(tok)
                 r.stage_add("prefill", now)
                 self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
                 self._defer_flow(r)
-                if self._finished(r, tok):
-                    self._complete(r)
-                    if self.blocks is not None:
-                        self.blocks.free_table(s.table)
-                    self._slots[i] = None
+                if self._seq_finished(s, tok):
+                    self._retire_seq(i, s)
         self._flush_trace_emits()
         return total
 
     def _preempt(self, slot: int, s: "_Seq") -> None:
         """Victim path for pool exhaustion: release the sequence's blocks
         and requeue its request at the FRONT of this engine's own queue —
-        it restarts from the prompt later (greedy decoding reproduces the
-        answer exactly; its prompt blocks likely still sit in the prefix
-        cache)."""
+        it restarts from the prompt later (position-keyed decoding —
+        greedy argmax or seeded sampling — reproduces the answer
+        exactly; its prompt blocks likely still sit in the prefix
+        cache).  An n>1 fork family is preempted as ONE unit: every
+        member's blocks are released, every member slot cleared, and the
+        request requeued once — half a fork group can never restart."""
+        members = s.group.seqs if s.group is not None else [s]
         with self._lock:
-            if self._slots[slot] is s:
-                self._slots[slot] = None
-        self.blocks.free_table(s.table)
+            if s.group is None:
+                if self._slots[slot] is s:
+                    self._slots[slot] = None
+            else:
+                for i, cur in enumerate(self._slots):
+                    if cur in members:
+                        self._slots[i] = None
+        for m in members:
+            self.blocks.free_table(m.table)
+            m.table = []
+        if s.group is not None:
+            s.group.completed = 0
+            s.group.forked = False
+            s.request.samples = [None] * s.request.n
         s.request.generated = []
         s.request.requeues += 1
         now = time.monotonic()
@@ -1467,43 +2043,61 @@ class InferenceEngine:
             "%s: preempted %s (KV pool exhausted); requeued",
             self.replica_id, s.request.request_id)
 
-    def _ensure_write_blocks(self, active):
-        """Guarantee each decoding sequence owns a writable block for
-        cache position ``length`` (growing its table, CoW-forking shared
-        blocks); preempts youngest-first on pool exhaustion.  Returns the
-        sequences that still hold a slot."""
+    def _ensure_write_blocks(self, active, extra=None):
+        """Guarantee each decoding sequence owns writable blocks for
+        cache positions ``length .. length + extra[i]`` (growing its
+        table, CoW-forking shared blocks — ``extra`` is the speculative
+        draft span; None/missing means just ``length``); preempts
+        youngest-first on pool exhaustion.  Returns the sequences that
+        still hold a slot."""
         ok = []
         for i, s in sorted(active, key=lambda t: t[1].admit_seq):
             with self._lock:
                 if self._slots[i] is not s:
                     continue  # preempted as an earlier sequence's victim
+            span = extra.get(i, 0) if extra else 0
+            bt = self.blocks.block_tokens
             placed = False
             while not placed:
+                with self._lock:
+                    if self._slots[i] is not s:
+                        break  # preempted (group victim) mid-retry
                 # Both arms can exhaust the pool (a CoW fork allocates
                 # too) — either way the youngest sequence is preempted
                 # and the arm retried.
                 try:
-                    bidx = s.length // self.blocks.block_tokens
-                    if bidx < len(s.table):
-                        old = s.table[bidx]
-                        bid, copied = self.blocks.ensure_writable(old)
-                        if copied:
-                            # Release the old reference only AFTER the
-                            # device copy succeeds (ensure_writable's
-                            # contract): a failed copy must not leave
-                            # the table pointing at a freed block.
-                            try:
-                                self._cache = self.adapter.copy_block(
-                                    self._cache, old, bid)
-                            except BaseException:
-                                self.blocks.free(bid)  # never entered
-                                raise                  # a table
-                            s.table[bidx] = bid
-                            self.blocks.free(old)
-                        placed = True
-                        ok.append((i, s))
-                        continue
-                    s.table.extend(self.blocks.allocate(1))
+                    for bidx in range(s.length // bt,
+                                      (s.length + span) // bt + 1):
+                        allocated = False
+                        if bidx < len(s.table):
+                            old = s.table[bidx]
+                            bid, copied = self.blocks.ensure_writable(old)
+                            if copied:
+                                # Release the old reference only AFTER
+                                # the device copy succeeds
+                                # (ensure_writable's contract): a failed
+                                # copy must not leave the table pointing
+                                # at a freed block.
+                                try:
+                                    self._cache = self.adapter.copy_block(
+                                        self._cache, old, bid)
+                                except BaseException:
+                                    self.blocks.free(bid)  # never entered
+                                    raise                  # a table
+                                s.table[bidx] = bid
+                                self.blocks.free(old)
+                                allocated = True
+                        else:
+                            s.table.extend(self.blocks.allocate(1))
+                            allocated = True
+                        # A fork-family allocation consumes one unit of
+                        # the tails admission reserved (CoW copy of the
+                        # shared partial block, or a decode extend).
+                        if allocated and s.group is not None \
+                                and s.group.reserve > 0:
+                            s.group.reserve -= 1
+                    placed = True
+                    ok.append((i, s))
                 except NoFreeBlocksError:
                     with self._lock:
                         live = [(j, t) for j, t in enumerate(self._slots)
@@ -1511,7 +2105,8 @@ class InferenceEngine:
                     victim_slot, victim = max(
                         live, key=lambda t: t[1].admit_seq)
                     self._preempt(victim_slot, victim)
-                    if victim is s:
+                    if victim is s or (s.group is not None
+                                       and victim in s.group.seqs):
                         placed = True  # s itself evicted; skip this step
         return ok
 
@@ -1531,13 +2126,37 @@ class InferenceEngine:
         tokens = np.zeros((self.max_batch,), np.int32)
         positions = np.zeros((self.max_batch,), np.int32)
         tables = np.full((self.max_batch, self._mb), nb, np.int32)
+        sampled_rows = False
         for i, s in active:
-            tokens[i] = s.request.generated[-1]
+            tokens[i] = s.generated[-1]
             positions[i] = s.length  # next cache index = current length
             tables[i, :len(s.table)] = s.table
+            sampled_rows = sampled_rows or s.request.sampled
         t0 = time.monotonic()
-        self._cache, nxt = self.adapter.decode_paged(
-            self._cache, tokens, positions, tables)
+        if sampled_rows:
+            # Any sampled row switches the whole batch to the sampled
+            # program (greedy rows ride along with temperature 0 —
+            # their argmax is computed identically); per-row keys fold
+            # only that row's (seed, sample, position), so batched ==
+            # single given the same key holds by construction.
+            keys = _sampling.base_keys_array(
+                [None] * self.max_batch, self.max_batch)
+            temps = np.zeros((self.max_batch,), np.float32)
+            top_ks = np.zeros((self.max_batch,), np.int32)
+            top_ps = np.ones((self.max_batch,), np.float32)
+            for i, s in active:
+                r = s.request
+                if r.sampled:
+                    keys[i] = s.base_key
+                    temps[i] = r.temperature
+                    top_ks[i] = r.top_k or 0
+                    top_ps[i] = r.top_p
+            self._cache, nxt = self.adapter.decode_paged_sampled(
+                self._cache, tokens, positions, tables, keys, temps,
+                top_ks, top_ps)
+        else:
+            self._cache, nxt = self.adapter.decode_paged(
+                self._cache, tokens, positions, tables)
         now = time.monotonic()
         # Inter-decode-step latency (see _decode_once): prefill chunks
         # between two decode steps land in this statistic by design.
@@ -1549,18 +2168,207 @@ class InferenceEngine:
                 if self._slots[i] is not s:
                     continue  # drained/preempted concurrently
                 tok = int(nxt[i])
-                s.request.generated.append(tok)
+                s.generated.append(tok)
                 s.length += 1
                 self._defer_flow(s.request)
-                if self._finished(s.request, tok) \
+                if self._seq_finished(s, tok) \
                         or s.length >= self.adapter.max_len:
-                    self._complete(s.request)
-                    if self.blocks is not None:
-                        self.blocks.free_table(s.table)
-                    self._slots[i] = None
+                    self._retire_seq(i, s)
         self.steps += 1
         self._flush_trace_emits()
         self.metrics.observe_decode_step(dt_ms, len(active), len(active))
+        if self.blocks is not None:
+            self.metrics.maybe_emit_timeline(kv_stats=self.blocks.stats())
+        else:
+            self.metrics.maybe_emit_timeline()
+        return len(active)
+
+    # -- speculative decoding (paged mode, HVD_SERVE_SPEC_K > 0) -------------
+
+    def _spec_once(self) -> int:
+        """One speculative iteration (Leviathan et al. 2023 / Chen et
+        al. 2023): the draft proposes up to k greedy tokens per decoding
+        sequence (k cheap batched draft steps sharing the target's KV
+        pool), then the target verifies all k+1 positions in ONE
+        multi-token step through the chunked-prefill machinery
+        (``verify_chunk``), amortizing the big model over every accepted
+        token.  Acceptance: greedy requests accept while the draft
+        matches the target argmax and emit the target's token at the
+        first mismatch — bit-identical to non-speculative greedy;
+        sampled requests accept draft d with probability ``p[d]`` (the
+        draft is a point mass, so Leviathan rejection reduces to that)
+        and resample the residual — the marginal is exactly the
+        filtered target distribution.  K/V scattered past a rejected
+        draft sits at positions >= the rolled-back length (masked, then
+        overwritten); table entries extended for drafting are freed so
+        a rejection leaks zero block refs."""
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None and s.decoding]
+        if not active:
+            self._step_anchor = None
+            return 0
+        # Per-row draft budget: the step always emits >= 1 non-draft
+        # token (correction or bonus), so drafting is capped at
+        # max_new-1 remaining and at the last cache position.
+        ks: Dict[int, int] = {}
+        for i, s in active:
+            r = s.request
+            ks[i] = max(min(self.spec_k,
+                            r.max_new_tokens - len(s.generated) - 1,
+                            self.adapter.max_len - 1 - s.length), 0)
+        pre_lens: Dict[int, int] = {}
+        if self._mb:
+            pre_lens = {i: len(s.table) for i, s in active}
+            active = self._ensure_write_blocks(active, extra=ks)
+            if not active:
+                self._step_anchor = None
+                return 0
+        nb = self.blocks.capacity if self.blocks is not None else 0
+        B = self.max_batch
+        t0 = time.monotonic()
+        drafts: Dict[int, List[int]] = {i: [] for i, _ in active}
+        cur = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        for i, s in active:
+            cur[i] = s.generated[-1]
+            pos[i] = s.length
+        max_k = max(ks[i] for i, _ in active)
+        for j in range(max_k):
+            rows = [(i, s) for i, s in active if ks[i] > j]
+            if not rows:
+                break
+            tokens = np.zeros((B,), np.int32)
+            positions = np.zeros((B,), np.int32)
+            tables = np.full((B, self._mb), nb, np.int32)
+            for i, s in rows:
+                tokens[i] = cur[i]
+                positions[i] = pos[i]
+                tables[i, :len(s.table)] = s.table
+            self._cache, proposed = self.adapter.draft_decode(
+                self._cache, tokens, positions, tables)
+            for i, s in rows:
+                d = int(proposed[i])
+                drafts[i].append(d)
+                cur[i] = d
+                pos[i] += 1
+        chunks = [[s.generated[-1]] + drafts[i] for i, s in active]
+        starts = [s.length for _, s in active]
+        tables_l = [list(s.table) for _, s in active]
+        self._cache, logits = self.adapter.verify_chunk(
+            self._cache, chunks, starts, tables_l)
+        now = time.monotonic()
+        dt_ms = (now - (self._step_anchor if self._step_anchor is not None
+                        else t0)) * 1e3
+        self._step_anchor = now
+        emitted_total = 0
+        drafted = accepted = rejected = 0
+        # Acceptance OUTSIDE the engine lock: the sampled arm runs
+        # per-token host-side draws (jax fold_in/uniform) and full-vocab
+        # filtered_probs sorts — the slow half of a sampled spec step.
+        # Only this loop thread mutates sequence state, so the reads are
+        # stable; application below re-checks slot ownership under the
+        # lock as every decode path does.  (A row drained/preempted
+        # during this pass still counts its drafted/accepted tokens —
+        # the draft and verify compute really happened.)
+        plan: List[Tuple[int, "_Seq", List[int], int]] = []
+        for row, (i, s) in enumerate(active):
+            r = s.request
+            k = ks[i]
+            lrow = logits[row]
+            ell = s.length
+            drafted += k
+            emit: List[int] = []
+            m = 0
+            rejected_here = False
+            for j in range(1, k + 1):
+                pl = lrow[j - 1]
+                d = drafts[i][j - 1]
+                if not r.sampled:
+                    tgt = int(np.argmax(pl))
+                    if d == tgt:
+                        emit.append(d)
+                        m += 1
+                        continue
+                    emit.append(tgt)
+                    rejected_here = True
+                    break
+                p = _sampling.filtered_probs(pl, r.temperature,
+                                             r.top_k, r.top_p)
+                if _sampling.accept_draw(s.base_key, ell + j) < p[d]:
+                    emit.append(d)
+                    m += 1
+                    continue
+                emit.append(_sampling.residual_sample(
+                    p, d, s.base_key, ell + j))
+                rejected_here = True
+                break
+            if not rejected_here:
+                # Every draft accepted: the bonus token from the
+                # target's last-position logits, keyed exactly as
+                # the non-speculative path would key that position.
+                pl = lrow[k]
+                if not r.sampled:
+                    emit.append(int(np.argmax(pl)))
+                else:
+                    emit.append(_sampling.sample_host(
+                        pl, s.base_key, ell + k + 1, r.temperature,
+                        r.top_k, r.top_p))
+            accepted += m
+            rejected += k - m
+            plan.append((i, s, emit, m))
+        with self._lock:
+            staged = set()
+            for i, s, emit, m in plan:
+                if self._slots[i] is not s:
+                    continue  # drained/preempted concurrently
+                r = s.request
+                ell = s.length
+                if id(r) not in staged:
+                    staged.add(id(r))
+                    r.stage_add("spec", now)
+                finished = False
+                for tok in emit:
+                    s.generated.append(tok)
+                    emitted_total += 1
+                    self._defer_flow(r)
+                    if self._seq_finished(s, tok):
+                        finished = True
+                        break
+                if finished:
+                    self._retire_seq(i, s)
+                    continue
+                # K/V is valid through position ell+m (the fed token +
+                # accepted drafts); the correction/bonus token is
+                # pending exactly like a plain decode step's output.
+                s.length = ell + m + 1
+                if s.length >= self.adapter.max_len:
+                    self._retire_seq(i, s)
+                elif self._mb:
+                    # Rejected-draft rollback: table entries extended
+                    # for drafting beyond what the accepted prefix
+                    # needs return to the pool NOW — never leak refs
+                    # past a rejection.
+                    keep = max(pre_lens.get(i, len(s.table)),
+                               self._blocks_for_tokens(s.length))
+                    if len(s.table) > keep:
+                        freed = len(s.table) - keep
+                        self.blocks.free_table(s.table[keep:])
+                        del s.table[keep:]
+                        # Refund the fork-tail reservation for rolled-
+                        # back draft extensions (capped at the
+                        # admission-time value): without this, repeated
+                        # reject/rollback cycles drain the reserve and
+                        # the admission budget stops protecting the
+                        # family's remaining decode tail.
+                        if s.group is not None:
+                            s.group.reserve = min(
+                                s.group.reserve + freed,
+                                s.group.reserve_cap)
+        self.steps += 1
+        self._flush_trace_emits()
+        self.metrics.observe_decode_step(dt_ms, len(active), emitted_total)
+        self.metrics.observe_spec(drafted, accepted, rejected)
         if self.blocks is not None:
             self.metrics.maybe_emit_timeline(kv_stats=self.blocks.stats())
         else:
@@ -1595,10 +2403,15 @@ class InferenceEngine:
         get_logger().exception(
             "%s: engine step failed: %s", self.replica_id, e)
         with self._lock:
+            failed = set()
             for i, s in enumerate(self._slots):
                 if s is not None:
-                    s.request.fail(e)
-                    self.metrics.count_request("error")
+                    if id(s.request) not in failed:
+                        # One fail/count per request even when an n>1
+                        # fork family holds several slots.
+                        failed.add(id(s.request))
+                        s.request.fail(e)
+                        self.metrics.count_request("error")
                     if self.blocks is not None:
                         self.blocks.free_table(s.table)
                     self._slots[i] = None
@@ -1633,7 +2446,8 @@ class InferenceEngine:
                 if paged:
                     self._admit_paged(block)
                     pre = self._prefill_step()
-                    dec = self._decode_once_paged()
+                    dec = (self._spec_once() if self.spec_k > 0
+                           else self._decode_once_paged())
                     if pre or dec:
                         self.metrics.observe_iteration(pre, dec)
                 else:
@@ -1649,10 +2463,19 @@ class InferenceEngine:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  eos_id: Optional[int] = None,
-                 timeout_s: float = 300.0) -> List[int]:
-        """Submit one request through the running loop and wait for it."""
+                 timeout_s: float = 300.0,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: float = 1.0,
+                 n: int = 1,
+                 seed: Optional[int] = None) -> List[int]:
+        """Submit one request through the running loop and wait for it
+        (n > 1: the returned list is sample 0; the full set is on the
+        request's ``samples`` — use a hand-built Request for that)."""
         if self._thread is None:
             self.start()
-        r = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        r = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    n=n, seed=seed)
         self.batcher.submit(r)
         return r.result(timeout=timeout_s)

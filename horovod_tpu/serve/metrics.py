@@ -81,16 +81,27 @@ class ServeMetrics:
         self.ttft_ms = Histogram()
         self.token_step_ms = Histogram()
         # Per-request stage decomposition (obs tracing, ROADMAP item 4):
-        # queue / prefill / decode / retry milliseconds per COMPLETED
+        # queue / prefill / decode / spec / retry milliseconds per
+        # COMPLETED
         # request, an exact partition of its end-to-end latency
         # (Request.stage_add) — the autoscaler's per-stage inputs beyond
         # the aggregate TTFT/token-step histograms above.
         self.stage_ms: Dict[str, Histogram] = {
             s: Histogram() for s in ("queue", "prefill", "decode",
-                                     "retry")}
+                                     "spec", "retry")}
         self.tokens_total = 0
         self.decode_steps_total = 0
         self.prefills_total = 0
+        # Speculative decoding (docs/serving.md): draft/verify token
+        # accounting — acceptance_rate = accepted / drafted, and
+        # decode_steps_total counts TARGET-model invocations (one per
+        # verify step), so target-calls-per-emitted-token is readable
+        # straight off the snapshot (the bench spec arm's acceptance
+        # bar).
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_rejected_total = 0
+        self.spec_steps_total = 0
         # Per-iteration prefill/decode token split (chunked prefill's
         # fairness statistic): prompt tokens processed vs decode tokens
         # produced, per engine iteration (serve/engine.py paged loop).
@@ -156,9 +167,25 @@ class ServeMetrics:
         with self._lock:
             self.requests[outcome] = self.requests.get(outcome, 0) + 1
 
+    def count_tokens(self, n: int) -> None:
+        """Tokens emitted outside the TTFT/decode-step observers (the
+        n-1 extra first tokens an n>1 fork moment draws)."""
+        with self._lock:
+            self.tokens_total += n
+
+    def observe_spec(self, drafted: int, accepted: int,
+                     rejected: int) -> None:
+        """One speculative step's draft accounting (engine._spec_once)."""
+        with self._lock:
+            self.spec_drafted_total += drafted
+            self.spec_accepted_total += accepted
+            self.spec_rejected_total += rejected
+            self.spec_steps_total += 1
+
     def observe_stage(self, stage: str, ms: float) -> None:
         """One completed request's time in ``stage`` (queue / prefill /
-        decode / retry) — engine._complete feeds every non-zero stage."""
+        decode / spec / retry) — engine._complete feeds every non-zero
+        stage."""
         with self._lock:
             h = self.stage_ms.get(stage)
             if h is None:
@@ -251,6 +278,18 @@ class ServeMetrics:
                     "decode_tokens": self.decode_tokens_total,
                     "iterations": self.iterations_total,
                 },
+                "spec": {
+                    "drafted": self.spec_drafted_total,
+                    "accepted": self.spec_accepted_total,
+                    "rejected": self.spec_rejected_total,
+                    "steps": self.spec_steps_total,
+                    "acceptance_rate": round(
+                        self.spec_accepted_total
+                        / self.spec_drafted_total, 4)
+                    if self.spec_drafted_total else 0.0,
+                },
+                "seq_forks": sum(s.get("seq_forks", 0)
+                                 for s in kv.values()),
                 "kv_blocks": kv,
                 "prefix_cache": {
                     "hit_tokens": hit_tokens,
@@ -292,7 +331,7 @@ class ServeMetrics:
             # request's end-to-end latency, docs/observability.md).
             lines.append("# HELP hvd_serve_stage_ms per-request latency "
                          "by lifecycle stage (queue|prefill|decode|"
-                         "retry), ms")
+                         "spec|retry), ms")
             lines.append("# TYPE hvd_serve_stage_ms histogram")
             for stage in sorted(self.stage_ms):
                 hist("hvd_serve_stage_ms", self.stage_ms[stage],
@@ -347,6 +386,36 @@ class ServeMetrics:
                 lines.append(
                     f'hvd_serve_kv_cow_copies_total{{replica="{rid}"}} '
                     f'{s.get("cow", 0)}')
+            # n>1 parallel sampling: sequences forked off a shared
+            # prompt through CoW block tables (engine.seq_forks — the
+            # PR 4 CoW path's first real consumer, observable from the
+            # first forked request) + the requests that forked.
+            lines.append("# TYPE hvd_serve_cow_forks_total counter")
+            for rid, s in sorted(kv.items()):
+                lines.append(
+                    f'hvd_serve_cow_forks_total{{replica="{rid}"}} '
+                    f'{s.get("seq_forks", 0)}')
+            lines.append("# TYPE hvd_serve_forked_requests_total counter")
+            for rid, s in sorted(kv.items()):
+                lines.append(
+                    f'hvd_serve_forked_requests_total{{replica="{rid}"}} '
+                    f'{s.get("forked_requests", 0)}')
+            # Speculative decoding: drafted/accepted/rejected token
+            # counters + the acceptance-rate gauge (docs/serving.md).
+            lines.append("# TYPE hvd_serve_spec_tokens_total counter")
+            for result, n in (("drafted", self.spec_drafted_total),
+                              ("accepted", self.spec_accepted_total),
+                              ("rejected", self.spec_rejected_total)):
+                lines.append(
+                    f'hvd_serve_spec_tokens_total{{result="{result}"}} '
+                    f'{n}')
+            lines.append("# TYPE hvd_serve_spec_steps_total counter")
+            lines.append(
+                f"hvd_serve_spec_steps_total {self.spec_steps_total}")
+            lines.append("# TYPE hvd_serve_spec_acceptance_rate gauge")
+            rate = (self.spec_accepted_total / self.spec_drafted_total
+                    if self.spec_drafted_total else 0.0)
+            lines.append(f"hvd_serve_spec_acceptance_rate {rate:g}")
             lines.append("# TYPE hvd_serve_prefix_cache_hit_rate gauge")
             for rid, s in sorted(kv.items()):
                 lines.append(

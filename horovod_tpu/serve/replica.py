@@ -93,8 +93,13 @@ class Replica:
             # against HVD_MEM_BUDGET_BYTES / probed HBM when known —
             # surfaced on healthz so an operator sees a mis-sized
             # BlockManager before it OOMs (docs/serving.md).
+            # n>1 CoW fork + speculative observability (ISSUE 11): the
+            # fork counters and spec config ride healthz next to the
+            # block stats, so the n-best path is visible per replica
+            # from the first forked request.
             for extra in ("pool_bytes", "weight_bytes",
-                          "kv_headroom_bytes"):
+                          "kv_headroom_bytes", "seq_forks",
+                          "forked_requests", "spec_k"):
                 if extra in kv:
                     out["kv_blocks"][extra] = kv[extra]
         return out

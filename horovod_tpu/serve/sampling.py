@@ -1,0 +1,282 @@
+"""Seeded sampling for the serve engine: temperature / top-k / top-p
+with per-request ``jax.random`` keys.
+
+The exactness contract (docs/serving.md) extends from "batched == single"
+to **batched == single given the same key**: every random draw is keyed
+by ``(request seed, sample index, token position)`` and never by batch
+position, iteration count, wall clock, or replica identity — so the
+tokens a sampled request receives are bit-identical whether it ran
+alone, packed in a full batch, forked n ways, or resubmitted to another
+replica after a failover (greedy replay exactness now holds for sampled
+requests too).
+
+Key derivation::
+
+    base  = fold_in(PRNGKey(seed), sample_index)     # one per sequence
+    k_pos = fold_in(base, position)                  # one per token
+
+``position`` is the 0-indexed sequence position the token OCCUPIES
+(prompt tokens occupy ``0..P-1``, the first generated token occupies
+``P``).  Speculative decoding draws its accept/resample randomness from
+the same per-position keys (``accept_draw`` folds an extra tag so the
+accept uniform and the resample draw stay independent), which keeps the
+draws independent of HOW a position was reached — plain decode, a spec
+bonus token, or a post-rejection resample.
+
+Three consumers:
+
+* **in-jit** — ``sample_batched`` runs under the adapters' decode
+  programs (vmapped per row, each row folding only its OWN key), so the
+  hot decode path stays one compiled program with sampling params as
+  traced per-row arrays (no recompiles across request mixes);
+* **host** — ``sample_host`` draws first tokens after prefill (where
+  n>1 forks need several draws from ONE logit row) and speculative
+  resamples.  Host and in-jit draws use different mechanics (inverse-CDF
+  vs Gumbel) — both sample the same filtered distribution, and each
+  position is always drawn by the same mechanism on every replay, so
+  determinism holds bit-for-bit;
+* **validation** — ``validate_params`` is the single home of the
+  ``/generate`` payload contract (HTTP 400 per field).
+
+Greedy (``temperature == 0``) ignores keys entirely and stays
+``argmax`` — bit-identical to the pre-sampling engine.
+"""
+
+from __future__ import annotations
+
+import random as _stdlib_random
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+#: fold_in tag separating the speculative ACCEPT uniform from the
+#: (re)sample draw at the same token position.
+_SPEC_ACCEPT_TAG = 0x5bec
+
+#: Defaults of the /generate sampling fields (docs/serving.md).
+DEFAULT_TEMPERATURE = 0.0
+DEFAULT_TOP_P = 1.0
+
+
+def new_seed() -> int:
+    """Server-assigned request seed (echoed in the response so a sampled
+    output is reproducible).  Host-side, request-scoped randomness — the
+    per-token draws all flow through jax.random keys derived from it."""
+    return _stdlib_random.getrandbits(31)
+
+
+def validate_params(temperature, top_k, top_p, n, seed
+                    ) -> Tuple[float, Optional[int], float, int, int]:
+    """Validate + normalize the sampling fields of one request.
+
+    Raises ``ValueError`` per field (the server maps it to HTTP 400);
+    returns ``(temperature, top_k, top_p, n, seed)`` with ``seed``
+    assigned when the client sent none."""
+    # JSON booleans are client bugs on every field, not numbers to
+    # coerce (True -> temperature 1.0 would silently serve a SAMPLED
+    # answer to a malformed request).
+    for name, value in (("temperature", temperature), ("top_k", top_k),
+                        ("top_p", top_p), ("n", n)):
+        if isinstance(value, bool):
+            raise ValueError(f"{name} must be a number, got {value!r}")
+    t = float(temperature)
+    if not np.isfinite(t) or t < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature!r}")
+    if top_k is not None:
+        k = float(top_k)
+        if not np.isfinite(k) or k != int(k):
+            raise ValueError(f"top_k must be an integer, got {top_k!r}")
+        top_k = int(k)
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k!r}")
+    p = float(top_p)
+    if not np.isfinite(p) or not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p!r}")
+    nf = float(n)
+    if not np.isfinite(nf) or nf != int(nf):
+        raise ValueError(f"n must be an integer, got {n!r}")
+    n = int(nf)
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n!r}")
+    if seed is None:
+        seed = new_seed()
+    elif isinstance(seed, bool) or not isinstance(seed, int):
+        # JSON floats/strings/bools are all client errors: a seed is the
+        # reproducibility handle, so a lossy coercion would be worse
+        # than a 400.
+        raise ValueError(f"seed must be an integer, got {seed!r}")
+    return t, top_k, p, n, int(seed)
+
+
+# ---------------------------------------------------------------------------
+# Key derivation
+# ---------------------------------------------------------------------------
+
+def seq_key(seed: int, sample_index: int = 0) -> np.ndarray:
+    """Per-sequence base key: ``fold_in(PRNGKey(seed), sample_index)``
+    as a host uint32[2] array (the legacy raw-key layout the engine
+    threads into its decode programs as a ``[B, 2]`` traced operand)."""
+    import jax
+    key = jax.random.fold_in(jax.random.PRNGKey(seed % (2 ** 31)),
+                             sample_index)
+    return np.asarray(key, dtype=np.uint32)
+
+
+def token_key(base_key: np.ndarray, position: int):
+    """The key for the token occupying ``position`` (module doc)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.random.fold_in(jnp.asarray(base_key, jnp.uint32),
+                              int(position))
+
+
+# ---------------------------------------------------------------------------
+# Filtered distributions (temperature -> top-k -> top-p)
+# ---------------------------------------------------------------------------
+
+def _filter_logits_jnp(logits, temperature, top_k, top_p):
+    """One row's filtered sampling logits, traceable (used under vmap
+    inside the decode programs).  ``top_k <= 0`` disables the top-k
+    filter; ``top_p == 1`` keeps every token."""
+    import jax
+    import jax.numpy as jnp
+    V = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, jnp.float32(1e-6))
+    desc = jnp.sort(scaled)[::-1]
+    k_eff = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = desc[k_eff - 1]
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    probs = jax.nn.softmax(masked)
+    ps = jnp.sort(probs)[::-1]
+    cs = jnp.cumsum(ps)
+    # A token is kept while the cumulative mass of strictly-better
+    # tokens is below top_p — the top-1 token is always kept, so the
+    # filtered support is never empty.
+    keep_sorted = (cs - ps) < top_p
+    thr = jnp.min(jnp.where(keep_sorted, ps, jnp.inf))
+    return jnp.where(probs >= thr, masked, -jnp.inf)
+
+
+def filtered_probs(logits: np.ndarray, temperature: float,
+                   top_k: Optional[int], top_p: float) -> np.ndarray:
+    """Host mirror of ``_filter_logits_jnp`` as a probability vector —
+    the target distribution ``p`` speculative rejection sampling must
+    preserve (accept prob, residual resample) and the reference the
+    chi-square distribution test checks against."""
+    logits = np.asarray(logits, np.float32)
+    V = logits.shape[-1]
+    scaled = logits / max(float(temperature), 1e-6)
+    desc = np.sort(scaled)[::-1]
+    k_eff = min(max(int(top_k) if top_k else V, 1), V)
+    kth = desc[k_eff - 1]
+    masked = np.where(scaled >= kth, scaled, -np.inf)
+    shifted = masked - np.max(masked)
+    e = np.exp(shifted, where=np.isfinite(shifted),
+               out=np.zeros_like(shifted))
+    probs = e / e.sum()
+    ps = np.sort(probs)[::-1]
+    cs = np.cumsum(ps)
+    keep_sorted = (cs - ps) < top_p
+    thr = np.min(np.where(keep_sorted, ps, np.inf))
+    probs = np.where(probs >= thr, probs, 0.0)
+    return probs / probs.sum()
+
+
+# ---------------------------------------------------------------------------
+# In-jit batched sampling (the decode hot path)
+# ---------------------------------------------------------------------------
+
+def sample_batched(logits, base_keys, positions, temperatures, top_ks,
+                   top_ps):
+    """Traceable batched sampler: one token per row of ``logits``
+    ``[B, V]``.
+
+    ``positions[b]`` is the sequence position row b's token will OCCUPY
+    (the caller passes ``fed_position + 1`` from its decode program);
+    each row folds only its OWN ``base_keys[b]`` — nothing here depends
+    on b itself, which is the whole batched==single-given-the-same-key
+    contract.  Rows with ``temperatures[b] <= 0`` return
+    ``argmax(logits[b])`` bit-identically to the greedy programs."""
+    import jax
+    import jax.numpy as jnp
+
+    def row(logit, key, pos, temp, tk, tp):
+        k = jax.random.fold_in(key, pos)
+        sampled = jax.random.categorical(
+            k, _filter_logits_jnp(logit, temp, tk, tp))
+        return jnp.where(temp > 0,
+                         sampled.astype(jnp.int32),
+                         jnp.argmax(logit).astype(jnp.int32))
+
+    return jax.vmap(row)(logits, base_keys, positions, temperatures,
+                         top_ks, top_ps)
+
+
+# ---------------------------------------------------------------------------
+# Host-side draws (first tokens, speculative accept/resample)
+# ---------------------------------------------------------------------------
+
+def _uniform(key) -> float:
+    import jax
+    return float(jax.random.uniform(key))
+
+
+def _draw_from_probs(probs: np.ndarray, u: float) -> int:
+    cdf = np.cumsum(probs)
+    return int(min(np.searchsorted(cdf, u * cdf[-1], side="right"),
+                   len(probs) - 1))
+
+
+def sample_host(logits: np.ndarray, base_key: np.ndarray, position: int,
+                temperature: float, top_k: Optional[int],
+                top_p: float) -> int:
+    """One host-side token draw for the token occupying ``position`` —
+    the first-token path after prefill (n>1 forks draw n tokens from one
+    logit row with n different base keys) and test references."""
+    if temperature <= 0:
+        return int(np.argmax(np.asarray(logits)))
+    probs = filtered_probs(logits, temperature, top_k, top_p)
+    return _draw_from_probs(probs, _uniform(token_key(base_key, position)))
+
+
+def accept_draw(base_key: np.ndarray, position: int) -> float:
+    """The speculative ACCEPT uniform for the token at ``position`` —
+    folded with a tag so it is independent of the same position's
+    (re)sample draw."""
+    import jax
+    return _uniform(jax.random.fold_in(token_key(base_key, position),
+                                       _SPEC_ACCEPT_TAG))
+
+
+def residual_sample(probs: np.ndarray, rejected_token: int,
+                    base_key: np.ndarray, position: int) -> int:
+    """Sample the residual distribution after rejecting a greedy draft.
+
+    The draft proposes its argmax (a point mass ``q = delta[d]``), so
+    Leviathan-style rejection reduces to: accept ``d`` with probability
+    ``p[d]``, else draw from ``max(p - delta[d], 0)`` renormalized —
+    i.e. ``p`` with the rejected token zeroed.  The marginal over
+    accept+resample is exactly ``p``; tests pin it with a chi-square
+    fit."""
+    residual = np.array(probs, np.float64)
+    residual[rejected_token] = 0.0
+    total = residual.sum()
+    if total <= 0.0:
+        # p was a point mass on the rejected token: acceptance prob was
+        # 1, so this is unreachable — guard anyway.
+        return int(rejected_token)
+    residual /= total
+    return _draw_from_probs(residual,
+                            _uniform(token_key(base_key, position)))
+
+
+def base_keys_array(seqs_keys: Sequence[Optional[np.ndarray]],
+                    width: int) -> np.ndarray:
+    """Pack per-row base keys into the ``[B, 2]`` uint32 operand of the
+    sampled decode programs (rows without a key — greedy or inactive —
+    get zeros; their temperature is 0 so the key is never used)."""
+    out = np.zeros((width, 2), np.uint32)
+    for i, k in enumerate(seqs_keys):
+        if k is not None:
+            out[i] = k
+    return out

@@ -206,7 +206,16 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 max_new_tokens=int(payload.get("max_new_tokens", 16)),
                 eos_id=payload.get("eos_id"),
                 timeout_s=timeout_s,
-                request_id=payload.get("request_id"))
+                request_id=payload.get("request_id"),
+                # Sampling fields (docs/serving.md): strict per-field
+                # validation lives in sampling.validate_params — any
+                # violation (temperature<0, top_k<1, top_p outside
+                # (0,1], n<1, non-int seed) raises ValueError → 400.
+                temperature=payload.get("temperature", 0.0),
+                top_k=payload.get("top_k"),
+                top_p=payload.get("top_p", 1.0),
+                n=payload.get("n", 1),
+                seed=payload.get("seed"))
         except (KeyError, TypeError, ValueError) as e:
             self._shed_log("bad_request", None, e)
             self._reply_json(400, {"error": str(e)})
@@ -245,13 +254,21 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if request.first_token_at is not None:
             ttft_ms = round(
                 (request.first_token_at - request.submitted_at) * 1e3, 3)
-        self._reply_json(200, {
+        body = {
             "request_id": request.request_id,
             "tokens": tokens,
             "replica": request.replica_id,
             "requeues": request.requeues,
             "ttft_ms": ttft_ms,
-        })
+            # The effective seed is echoed on EVERY response (greedy
+            # included): resubmitting the same prompt with this seed
+            # reproduces a sampled answer bit-for-bit.
+            "seed": request.seed,
+        }
+        if request.n > 1:
+            body["n"] = request.n
+            body["completions"] = request.samples
+        self._reply_json(200, body)
         return 200
 
     def _shed_log(self, outcome: str, request, exc) -> None:

@@ -53,6 +53,8 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_PREFIX_CACHE", "HVD_SERVE_KV_MODE",
                 "HVD_SERVE_ATTN_IMPL", "HVD_SERVE_KV_DTYPE",
                 "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
+                "HVD_SERVE_SPEC_K", "HVD_SERVE_DRAFT_LAYERS",
+                "BENCH_SERVE_SPEC_K", "BENCH_SERVE_SAMPLE_TEMP",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
@@ -259,6 +261,31 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert trace["sample1_tokens_per_sec"] > 0
         assert trace["outputs_match"] is True  # tracing never corrupts
         assert trace["spans"] > 0 and trace["shards"] >= 1
+        # ISSUE 11: the spec arm — greedy speculation is bit-exact and
+        # amortizes the target model (acceptance bar: <= 0.67 target
+        # decode invocations per emitted token at k=4, i.e. >= 1.5x).
+        spec = last["spec"]
+        for key in ("spec_k", "draft_layers", "outputs_match",
+                    "acceptance_rate", "drafted", "accepted",
+                    "target_calls_per_token", "tokens_per_sec",
+                    "baseline_tokens_per_sec"):
+            assert key in spec, f"spec.{key} missing: {spec}"
+        assert spec["spec_k"] == 4
+        assert spec["outputs_match"] is True  # spec-greedy ≡ greedy
+        assert spec["drafted"] > 0
+        assert spec["target_calls_per_token"] <= 0.67
+        # ISSUE 11: the sampling arm — seeded storm determinism and the
+        # CoW n-best footprint (n=4 peak pool strictly < 4x the n=1
+        # footprint: prompt blocks shared through CoW tables).
+        sam = last["sampling"]
+        for key in ("temperature", "deterministic", "cow_forks",
+                    "forked_requests", "n1_peak_pool_bytes",
+                    "n4_peak_pool_bytes", "pool_share_ratio"):
+            assert key in sam, f"sampling.{key} missing: {sam}"
+        assert sam["deterministic"] is True  # same seeds → same outputs
+        assert sam["cow_forks"] == 3 and sam["forked_requests"] == 1
+        assert sam["pool_share_ratio"] < 1.0
+        assert sam["n4_peak_pool_bytes"] < 4 * sam["n1_peak_pool_bytes"]
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -355,6 +355,91 @@ def test_hvd009_ellipsis_body_is_silent():
     assert fired(src) == [("HVD009", 3)]
 
 
+def _serve_fired(src):
+    return [(f.rule, f.line) for f in lint_source(
+        textwrap.dedent(src), path="horovod_tpu/serve/corpus.py")
+        if not f.suppressed]
+
+
+def test_hvd010_clock_seeded_serving_prng():
+    src = """\
+    import time
+    import jax
+
+    def handler():
+        return jax.random.PRNGKey(int(time.time()))
+    """
+    assert _serve_fired(src) == [("HVD010", 5)]
+    # datetime provenance counts as a clock too.
+    src_dt = """\
+    import datetime
+    import jax
+
+    def handler():
+        seed = int(datetime.datetime.now().timestamp())
+        return jax.random.fold_in(jax.random.PRNGKey(seed), 1)
+    """
+    # Only the PRNGKey(seed) site is clock-free (seed is a Name by the
+    # time it reaches the call) — the clock lives in the assignment; the
+    # WHOLE-expression form is what the rule sees through:
+    src_inline = """\
+    import datetime
+    import jax
+
+    def handler():
+        return jax.random.PRNGKey(
+            int(datetime.datetime.now().timestamp()))
+    """
+    assert _serve_fired(src_inline) == [("HVD010", 5)]
+    del src_dt  # documented limitation: assigned-then-used clock seeds
+
+
+def test_hvd010_constant_seeded_serving_prng():
+    src = """\
+    import jax
+
+    def handler():
+        k = jax.random.PRNGKey(0)
+        return jax.random.fold_in(k, position)
+    """
+    assert _serve_fired(src) == [("HVD010", 4)]
+    both_const = """\
+    import jax
+
+    def handler():
+        return jax.random.fold_in(jax.random.PRNGKey(seed), 3)
+    """
+    assert _serve_fired(both_const) == []
+
+
+def test_hvd010_request_derived_keys_are_clean_and_rule_is_serve_scoped():
+    clean = """\
+    import jax
+
+    def seq_key(seed, sample_index):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed % (2 ** 31)),
+                                 sample_index)
+        return key
+
+    def token_key(base_key, position):
+        return jax.random.fold_in(base_key, int(position))
+    """
+    assert _serve_fired(clean) == []
+    # The same constant seed OUTSIDE serve/ is fine (tests, examples,
+    # training init all use PRNGKey(0) legitimately).
+    dirty_elsewhere = """\
+    import jax
+    k = jax.random.PRNGKey(0)
+    """
+    assert fired(dirty_elsewhere) == []
+    # dict.key()-shaped calls never match.
+    not_prng = """\
+    def f(d):
+        return d.key(0)
+    """
+    assert [r for r, _ in _serve_fired(not_prng)] == []
+
+
 def test_join_collective_requires_hvd_base():
     """os.path.join / ','.join / thread.join must not read as the hvd.join
     collective (the false positives the first dogfooding run surfaced)."""
