@@ -36,7 +36,7 @@ or, with no code changes::
 
 from .plan import (  # noqa: F401
     DEFAULT_POINT, HORIZON, KINDS, POINTS, FaultInjected, FaultPlan,
-    FaultSpec, parse_plan, parse_spec,
+    FaultSpec, diurnal_load, parse_plan, parse_spec,
 )
 from .runtime import (  # noqa: F401
     active_plan, fire, install, maybe_install_from_env, uninstall,

@@ -35,16 +35,18 @@ and when.
 
 from __future__ import annotations
 
+import math
 import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
 #: Fault kinds (docs/fault_injection.md has the per-kind semantics).
 KINDS = ("kill-rank", "delay-kv", "drop-kv-response", "poison-step",
-         "slow-decode", "pool-corrupt-block")
+         "slow-decode", "pool-corrupt-block", "load-spike")
 
 #: Injection points threaded through the codebase.
-POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll")
+POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll",
+          "ctl.poll")
 
 #: Default injection point per kind (a spec may override, e.g. kill-rank
 #: at replica.route fires report_rank_lost directly instead of going
@@ -56,6 +58,11 @@ DEFAULT_POINT = {
     "poison-step": "engine.step",
     "slow-decode": "engine.step",
     "pool-corrupt-block": "engine.step",
+    # A burst of ``param`` synthetic throughput-tier admissions at the
+    # fleet controller's poll boundary (serve/controller.py) — the
+    # overload the autoscaler/brownout ladder must absorb, as a seeded
+    # scheduled fault rather than wall-clock client chance.
+    "load-spike": "ctl.poll",
 }
 
 #: Step-assignment window for specs without an explicit ``@step``: drawn
@@ -164,6 +171,31 @@ def parse_plan(text: str, seed: int = 0) -> "FaultPlan":
     """``HVD_FAULTLINE_PLAN``: comma-separated :func:`parse_spec` items."""
     specs = [parse_spec(t) for t in text.split(",") if t.strip()]
     return FaultPlan(specs, seed=seed)
+
+
+def diurnal_load(steps: int, peak: int, base: int = 0, seed: int = 0,
+                 jitter: float = 0.25) -> List[int]:
+    """Seeded diurnal load shape: per-step request counts sweeping
+    ``base`` → ``peak`` → ``base`` over ``steps`` ticks (half-sine)
+    with seeded multiplicative jitter — realistic texture, yet a pure
+    function of its arguments, so the chaos soak and the bench
+    autoscale arm replay the identical curve (docs/fault_injection.md).
+    The same discipline as fault steps: LOAD is data, not wall-clock
+    chance."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if not 0 <= base <= peak:
+        raise ValueError(f"need 0 <= base <= peak, got {base}/{peak}")
+    if not 0.0 <= jitter < 1.0:
+        raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+    rng = random.Random(seed)
+    out: List[int] = []
+    for i in range(steps):
+        level = base + (peak - base) * math.sin(
+            math.pi * (i + 0.5) / steps)
+        level *= 1.0 + jitter * (rng.random() * 2.0 - 1.0)
+        out.append(max(int(round(level)), 0))
+    return out
 
 
 class FaultPlan:

@@ -16,9 +16,11 @@ Layers (docs/serving.md has the architecture):
   / ``HVD_SERVE_KV_DTYPE``);
 * :mod:`engine`  — paged (default) / slot KV cache, chunked prefill,
   iteration-level decode loop;
-* :mod:`batcher` — bounded queue, size/deadline triggers, shape buckets,
-  block-budget admission;
+* :mod:`batcher` — bounded queue, size/deadline triggers, QoS tiers +
+  EDF ordering, shape buckets, block-budget admission;
 * :mod:`replica` — process-set replicas, least-loaded routing, failover;
+* :mod:`controller` — hvdctl: SLO-aware autoscaling + the brownout
+  ladder (docs/serving.md control plane);
 * :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
   ``hvdserve`` CLI;
 * :mod:`metrics` — TTFT / per-token histograms, occupancy, tokens/s.
@@ -47,6 +49,9 @@ from .batcher import (  # noqa: F401,E402
 )
 from .blocks import (  # noqa: F401
     BlockManager, NoFreeBlocksError, chain_hashes,
+)
+from .controller import (  # noqa: F401
+    ControllerConfig, ControllerState, FleetController, FleetSnapshot,
 )
 from .engine import (  # noqa: F401
     InferenceEngine, MLPAdapter, ModelAdapter, TransformerAdapter,

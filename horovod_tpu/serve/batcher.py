@@ -31,10 +31,16 @@ cache memory instead of slot count.
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
+
+#: QoS admission tiers (docs/serving.md control plane): ``latency`` is
+#: the SLO-bearing interactive class, ``throughput`` the best-effort
+#: batch class — first shed under brownout, bounded separately.
+QOS_TIERS = ("latency", "throughput")
 
 
 class QueueFullError(Exception):
@@ -85,10 +91,18 @@ class Request:
                  top_k: Optional[int] = None,
                  top_p: float = 1.0,
                  n: int = 1,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 qos: str = "latency"):
         from .sampling import validate_params
         (self.temperature, self.top_k, self.top_p, self.n,
          self.seed) = validate_params(temperature, top_k, top_p, n, seed)
+        if qos not in QOS_TIERS:
+            # The server maps this to HTTP 400 like every other
+            # validation error — an unknown tier must never silently
+            # land in the default class.
+            raise ValueError(
+                f"qos must be one of {QOS_TIERS}, got {qos!r}")
+        self.qos = qos
         if not prompt:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
@@ -223,6 +237,25 @@ def bucket_requests(requests: Sequence[Request],
     return groups
 
 
+def _order_key(r: Request):
+    """Admission order within the queue (sorted at take time, QoS tiers):
+
+    1. requeued work first, in its CURRENT queue position (the
+       ``requeue_front`` contract — already-accepted work drained off a
+       dead replica outranks everything, and Python's stable sort keeps
+       the chunk order ``mark_dead`` dealt);
+    2. latency tier before throughput tier;
+    3. earliest deadline first within a tier (EDF — the expiry check
+       alone sheds late work but never PRIORITIZES urgent work);
+    4. FIFO arrival (stable sort) for deadline-less peers — exactly the
+       pre-QoS order, so single-tier deadline-less traffic is untouched.
+    """
+    if r.requeues:
+        return (0, 0, 0.0)
+    return (1, 0 if r.qos == "latency" else 1,
+            r.deadline if r.deadline is not None else math.inf)
+
+
 class DynamicBatcher:
     """Bounded FIFO with size/deadline admission triggers (module doc)."""
 
@@ -233,6 +266,22 @@ class DynamicBatcher:
             os.environ.get("HVD_SERVE_MAX_QUEUE", "256"))
         self.max_wait_s = (max_wait_ms if max_wait_ms is not None else float(
             os.environ.get("HVD_SERVE_MAX_WAIT_MS", "5"))) / 1e3
+        # Per-tier queue bounds (0 = unbounded within max_queue): the
+        # throughput tier is typically bounded tighter so a batch burst
+        # can never crowd interactive traffic out of the shared queue.
+        self.tier_bounds: Dict[str, int] = {
+            "latency": int(os.environ.get("HVD_SERVE_QOS_LAT_QUEUE", "0")),
+            "throughput": int(
+                os.environ.get("HVD_SERVE_QOS_TPT_QUEUE", "0"))}
+        # Brownout rung (serve/controller.py ladder), set by the
+        # FleetController and read lock-free here (plain int, GIL-atomic;
+        # a rung change is advisory and takes effect on the next submit):
+        # >=1 sheds new throughput-tier submissions, >=3 rejects n>1
+        # forking, >=4 purges already-queued throughput work at
+        # admission time.  ``brownout_max_new`` (rung 2+; 0 = no cap)
+        # caps each taken request's effective max_new_tokens.
+        self.brownout_level = 0
+        self.brownout_max_new = 0
         self._on_shed = on_shed
         self._queue: List[Request] = []
         self._lock = threading.Lock()
@@ -240,6 +289,13 @@ class DynamicBatcher:
         self._closed = False
 
     def submit(self, request: Request) -> None:
+        level = self.brownout_level
+        if level >= 1 and request.qos == "throughput":
+            raise QueueFullError(
+                f"brownout level {level}: throughput tier shed")
+        if level >= 3 and request.n > 1:
+            raise QueueFullError(
+                f"brownout level {level}: n>1 forking disabled")
         with self._cond:
             if self._closed:
                 raise QueueFullError("batcher is closed")
@@ -250,6 +306,11 @@ class DynamicBatcher:
                 # distinguishable.
                 raise QueueFullError(
                     f"queue at capacity ({self.max_queue})")
+            bound = self.tier_bounds.get(request.qos, 0)
+            if bound and sum(1 for r in self._queue
+                             if r.qos == request.qos) >= bound:
+                raise QueueFullError(
+                    f"{request.qos} tier at capacity ({bound})")
             self._queue.append(request)
             self._cond.notify_all()
 
@@ -292,8 +353,15 @@ class DynamicBatcher:
         # fails them loudly at admission.
         taken: List[Request] = []
         remaining = budget
+        cap = self.brownout_max_new
         while self._queue and len(taken) < free_slots:
             r = self._queue[0]
+            if cap and r.max_new_tokens > cap:
+                # Brownout rung 2+ caps the effective max_new_tokens
+                # HERE, before cost() sees the request — the admission
+                # budget, block allocation, and fork-tail reserves must
+                # all agree on the capped lifetime.
+                r.max_new_tokens = cap
             if cost is not None:
                 c = cost(r)
                 if hard_cap is not None and c > hard_cap:
@@ -324,15 +392,36 @@ class DynamicBatcher:
             return []
         deadline = time.monotonic() + block_s
         expired: List[Request] = []
+        purged: List[Request] = []
         try:
             with self._cond:
                 while True:
                     now = time.monotonic()
                     self._pop_expired(now, expired)
+                    if self.brownout_level >= 4 and self._queue:
+                        # Rung 4 (latency-tier-only admission): queued
+                        # throughput-tier work is purged — removed here,
+                        # failed after the lock drops (the expiry
+                        # discipline; see _pop_expired).
+                        kept = []
+                        for r in self._queue:
+                            (purged if r.qos == "throughput"
+                             else kept).append(r)
+                        self._queue = kept
                     if self._queue:
-                        oldest_age = now - self._queue[0].submitted_at
+                        # The EDF sort below means queue[0] need not be
+                        # the oldest arrival — the deadline trigger
+                        # scans for the true oldest.
+                        oldest_age = now - min(r.submitted_at
+                                               for r in self._queue)
                         if (len(self._queue) >= free_slots
                                 or oldest_age >= self.max_wait_s):
+                            # QoS/EDF ordering happens at TAKE time, not
+                            # submit time — tiers and deadlines can only
+                            # reorder work that actually waited
+                            # (_order_key; stable, so deadline-less
+                            # single-tier traffic keeps exact FIFO).
+                            self._queue.sort(key=_order_key)
                             taken = self._take(free_slots, budget, cost,
                                                hard_cap)
                             if taken:
@@ -360,6 +449,14 @@ class DynamicBatcher:
                     f"{time.monotonic() - r.submitted_at:.3f}s in queue"))
                 if self._on_shed:
                     self._on_shed(r, "expired")
+            for r in purged:
+                # QueueFullError → the client's 503/Retry-After path: a
+                # brownout purge is a shed, not a deadline miss.
+                r.fail(QueueFullError(
+                    f"brownout level {self.brownout_level}: "
+                    f"latency-tier-only admission"))
+                if self._on_shed:
+                    self._on_shed(r, "shed")
 
     def drain(self) -> List[Request]:
         """Empty the queue and return the requests (dead-replica path —

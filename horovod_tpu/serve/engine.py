@@ -1102,10 +1102,18 @@ class InferenceEngine:
         self.metrics = metrics or ServeMetrics()
         if self.batcher._on_shed is None:
             # Deadline sheds happen inside the batcher (at admission);
-            # surface them in this engine's metrics ("expired" outcome).
+            # surface them in this engine's metrics ("expired" outcome
+            # — and "shed" for brownout purges, which pass that reason).
             self.batcher._on_shed = \
                 lambda req, why: self.metrics.count_request(why)
         self.replica_id = replica_id
+        # Brownout rung (serve/controller.py), set by the
+        # FleetController and read lock-free in the loop (plain int,
+        # GIL-atomic): >=3 disables speculative decoding — the greedy
+        # fallback is bit-identical (the spec exactness contract), it
+        # just stops spending draft compute and draft-tail KV blocks
+        # under pressure.  The admission-side rungs live on the batcher.
+        self.brownout_level = 0
         mode = (kv_mode or os.environ.get("HVD_SERVE_KV_MODE",
                                           "auto")).lower()
         paged_capable = all(
@@ -1484,10 +1492,17 @@ class InferenceEngine:
             r.stage_add("decode", now)
         # Stage decomposition feeds /metrics unconditionally (the
         # autoscaler inputs, docs/observability.md); the SPANS only for
-        # sampled requests.
+        # sampled requests.  Each stage is emitted twice: the all-tiers
+        # aggregate and the per-QoS-tier series ("stage|tier" key) the
+        # controller's per-class SLO accounting reads.
         for stage, ms in r.stage_ms.items():
             if ms > 0.0:
                 self.metrics.observe_stage(stage, ms)
+                self.metrics.observe_stage(f"{stage}|{r.qos}", ms)
+        # End-to-end latency per tier (the stage partition's sum — the
+        # windowed-p99 input of the controller's SLO check) + the
+        # service-time EWMA behind the load-aware Retry-After hint.
+        self.metrics.observe_request_ms(r.qos, sum(r.stage_ms.values()))
         if r.trace is not None and _obs.TRACER is not None:
             t = _obs.TRACER
 
@@ -2446,7 +2461,8 @@ class InferenceEngine:
                 if paged:
                     self._admit_paged(block)
                     pre = self._prefill_step()
-                    dec = (self._spec_once() if self.spec_k > 0
+                    dec = (self._spec_once()
+                           if self.spec_k > 0 and self.brownout_level < 3
                            else self._decode_once_paged())
                     if pre or dec:
                         self.metrics.observe_iteration(pre, dec)

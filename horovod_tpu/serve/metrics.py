@@ -122,6 +122,19 @@ class ServeMetrics:
         # fleet's shrink/grow events, docs/serving.md scale-up).
         self.replica_events: Dict[str, int] = {"mark_dead": 0,
                                                "mark_alive": 0}
+        # Fleet-controller plane (serve/controller.py): current brownout
+        # rung (gauge), controller action counters, and per-QoS-tier
+        # end-to-end request-latency histograms — the latency-tier one
+        # is what the controller's windowed SLO check diffs between
+        # polls.
+        self.brownout_level = 0
+        self.ctl_events: Dict[str, int] = {}
+        self.request_ms: Dict[str, Histogram] = {
+            "latency": Histogram(), "throughput": Histogram()}
+        # EWMA of per-request service time (ms), all tiers — the queue-
+        # drain-rate input of the load-aware Retry-After hint
+        # (server._budget_headers).
+        self._service_ms: Optional[float] = None
         # Batch occupancy: sequences active per decode step.
         self.occupancy_last = 0
         self.occupancy_max = 0
@@ -191,6 +204,54 @@ class ServeMetrics:
             if h is None:
                 h = self.stage_ms[stage] = Histogram()
             h.observe(ms)
+
+    def observe_request_ms(self, tier: str, ms: float) -> None:
+        """One COMPLETED request's end-to-end latency by QoS tier
+        (engine._complete — the sum of its stage_ms partition).  Also
+        advances the service-time EWMA the Retry-After hint reads."""
+        with self._lock:
+            h = self.request_ms.get(tier)
+            if h is None:
+                h = self.request_ms[tier] = Histogram()
+            h.observe(ms)
+            self._service_ms = (ms if self._service_ms is None
+                                else 0.2 * ms + 0.8 * self._service_ms)
+
+    def recent_service_s(self) -> float:
+        """EWMA per-request service time in SECONDS (0.0 until the
+        first completion) — depth x this = the queue-drain estimate
+        behind the load-aware Retry-After hint."""
+        with self._lock:
+            return (self._service_ms or 0.0) / 1e3
+
+    def request_window(self, tier: str):
+        """``(bounds, cumulative bucket counts, total count)`` snapshot
+        of one tier's request-latency histogram — the controller diffs
+        consecutive snapshots for its WINDOWED p99 (controller.py)."""
+        with self._lock:
+            h = self.request_ms.get(tier)
+            if h is None:
+                return ([], [], 0)
+            return (list(h.bounds), list(h.counts), h.count)
+
+    def set_brownout_level(self, level: int, reason: str = "") -> None:
+        """Controller rung walk: gauge update + BROWNOUT timeline
+        instant (``reason`` is the action, e.g. ``brownout_up``)."""
+        with self._lock:
+            self.brownout_level = int(level)
+            tl = self._timeline
+        if tl is None:
+            return
+        try:
+            tl.brownout_event(
+                "down" if reason.endswith("down") else "up",
+                level, rung=reason)
+        except Exception:
+            pass  # the metrics path must never take down the controller
+
+    def count_ctl_event(self, event: str) -> None:
+        with self._lock:
+            self.ctl_events[event] = self.ctl_events.get(event, 0) + 1
 
     def count_preempt_poll_error(self) -> None:
         with self._lock:
@@ -264,6 +325,10 @@ class ServeMetrics:
                 "prefills": self.prefills_total,
                 "requests": dict(self.requests),
                 "replica_events": dict(self.replica_events),
+                "brownout_level": self.brownout_level,
+                "ctl_events": dict(self.ctl_events),
+                "request_latency": {t: h.to_dict()
+                                    for t, h in self.request_ms.items()},
                 "preempt_poll_errors": self.preempt_poll_errors,
                 "occupancy": {"last": self.occupancy_last,
                               "max": self.occupancy_max,
@@ -334,8 +399,23 @@ class ServeMetrics:
                          "spec|retry), ms")
             lines.append("# TYPE hvd_serve_stage_ms histogram")
             for stage in sorted(self.stage_ms):
+                # "stage|tier" keys (engine._complete's per-QoS-tier
+                # emission) render as a two-label series; plain keys
+                # stay the all-tiers aggregate the dashboards already
+                # chart.
+                if "|" in stage:
+                    s, tier = stage.split("|", 1)
+                    labels = f'stage="{s}",tier="{tier}"'
+                else:
+                    labels = f'stage="{stage}"'
                 hist("hvd_serve_stage_ms", self.stage_ms[stage],
-                     labels=f'stage="{stage}"')
+                     labels=labels)
+            lines.append("# HELP hvd_serve_request_ms end-to-end "
+                         "request latency by QoS tier, ms")
+            lines.append("# TYPE hvd_serve_request_ms histogram")
+            for tier in sorted(self.request_ms):
+                hist("hvd_serve_request_ms", self.request_ms[tier],
+                     labels=f'tier="{tier}"')
             lines.append("# TYPE hvd_serve_tokens_total counter")
             lines.append(f"hvd_serve_tokens_total {self.tokens_total}")
             lines.append("# TYPE hvd_serve_decode_steps_total counter")
@@ -354,6 +434,15 @@ class ServeMetrics:
                 lines.append(
                     f'hvd_serve_replica_events_total{{event="{event}"}} '
                     f'{n}')
+            # Fleet-controller plane (serve/controller.py): the current
+            # brownout rung and the controller's action tallies.
+            lines.append("# TYPE hvd_serve_brownout_level gauge")
+            lines.append(
+                f"hvd_serve_brownout_level {self.brownout_level}")
+            lines.append("# TYPE hvd_serve_ctl_events_total counter")
+            for event, n in sorted(self.ctl_events.items()):
+                lines.append(
+                    f'hvd_serve_ctl_events_total{{event="{event}"}} {n}')
             lines.append("# TYPE hvd_serve_batch_occupancy gauge")
             lines.append(f"hvd_serve_batch_occupancy {self.occupancy_last}")
             lines.append("# TYPE hvd_serve_batch_occupancy_max gauge")

@@ -134,6 +134,13 @@ class ReplicaScheduler:
         with self._lock:
             return [r for r in self.replicas if r.state == "healthy"]
 
+    def fleet(self) -> List[Replica]:
+        """Point-in-time copy of the replica list (any state) — the
+        FleetController's snapshot/actuation view (controller.py); the
+        copy means its per-replica sampling never runs under our lock."""
+        with self._lock:
+            return list(self.replicas)
+
     def submit(self, request: Request) -> Replica:
         """Least-loaded routing with failover: a replica at queue capacity
         backpressures; the next-least-loaded healthy replica is tried

@@ -28,8 +28,18 @@ is gone and fails in-flight sequences whose deadline passes mid-decode.
 Both shed responses (503/504) carry the request's REMAINING budget in
 ``X-Deadline-Remaining-S`` (exact seconds), so a client or proxy can
 decide whether a retry still fits its own SLO instead of retrying into
-certain death; ``Retry-After`` remains the server's minimum-wait
-availability hint (1 s), capped by that budget.
+certain death; ``Retry-After`` is the server's minimum-wait availability
+hint, derived from the queue drain rate (fleet-wide queued depth × the
+recent per-request service time, capped at
+``HVD_SERVE_RETRY_AFTER_CAP_S``) and capped by that budget — a flat
+hint would synchronize every shed client into a thundering herd that
+arrives together and sheds together.
+
+QoS admission tiers (docs/serving.md control plane): the ``qos``
+payload field or ``X-QoS-Tier`` header (payload wins) selects
+``latency`` (the SLO-bearing class, the default) or ``throughput``
+(best-effort batch — shed first under brownout, bounded separately);
+anything else is a 400.
 
 ``hvdserve`` (pyproject console script) stands up a replica world over
 the initialized runtime — see ``run_commandline``.
@@ -86,18 +96,40 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self._reply(code, json.dumps(obj).encode(),
                     extra_headers=extra_headers)
 
-    @staticmethod
-    def _budget_headers(request) -> tuple:
+    def _retry_after_s(self) -> int:
+        """Load-aware ``Retry-After`` hint: the estimated seconds for
+        the current fleet-wide queue to drain — total queued depth ×
+        the recent per-request service time (EWMA, serve/metrics.py),
+        spread over the healthy replicas — clamped to
+        [1, ``HVD_SERVE_RETRY_AFTER_CAP_S``].  A flat hint synchronizes
+        every shed client into a thundering herd that retries together
+        and re-sheds together; a drain-rate hint tells them when
+        capacity plausibly exists."""
+        metrics = self.server.metrics
+        depth = sum(max(d, 0)
+                    for d in metrics._queue_depths().values())
+        svc_s = metrics.recent_service_s()
+        if depth <= 0 or svc_s <= 0.0:
+            return 1
+        healthy = sum(1 for r in self.server.scheduler.fleet()
+                      if r.state == "healthy")
+        cap = int(os.environ.get("HVD_SERVE_RETRY_AFTER_CAP_S", "8"))
+        hint = -(-depth * svc_s // max(healthy, 1))  # ceil division
+        return max(1, min(int(hint), max(cap, 1)))
+
+    def _budget_headers(self, request) -> tuple:
         """503/504 shed headers (module doc).  ``Retry-After`` is the
         MINIMUM wait a compliant client honors, so it stays the server's
-        availability hint (the legacy 1 s) merely CAPPED by the client's
-        remaining budget — advertising the full budget there would make
-        a well-behaved client sleep its budget away and retry with
-        nothing left.  The exact budget rides X-Deadline-Remaining-S."""
+        availability hint (``_retry_after_s``) merely CAPPED by the
+        client's remaining budget — advertising the full budget there
+        would make a well-behaved client sleep its budget away and retry
+        with nothing left.  The exact budget rides
+        X-Deadline-Remaining-S."""
+        hint = self._retry_after_s()
         remaining = request.remaining()
         if remaining is None:
-            return (("Retry-After", "1"),)
-        return (("Retry-After", str(min(1, int(remaining)))),
+            return (("Retry-After", str(hint)),)
+        return (("Retry-After", str(min(hint, int(remaining)))),
                 ("X-Deadline-Remaining-S", f"{remaining:.3f}"))
 
     # -- routes --------------------------------------------------------------
@@ -201,6 +233,12 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 timeout_s = float(header) if header is not None else None
             if timeout_s is not None:
                 timeout_s = float(timeout_s)  # Request rejects <= 0
+            # QoS tier (module doc): payload field wins over the
+            # X-QoS-Tier header, like the timeout; Request validates
+            # membership (unknown tier -> ValueError -> 400).
+            qos = payload.get("qos")
+            if qos is None:
+                qos = self.headers.get("X-QoS-Tier") or "latency"
             request = Request(
                 prompt,
                 max_new_tokens=int(payload.get("max_new_tokens", 16)),
@@ -215,7 +253,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 top_k=payload.get("top_k"),
                 top_p=payload.get("top_p", 1.0),
                 n=payload.get("n", 1),
-                seed=payload.get("seed"))
+                seed=payload.get("seed"),
+                qos=str(qos).strip().lower())
         except (KeyError, TypeError, ValueError) as e:
             self._shed_log("bad_request", None, e)
             self._reply_json(400, {"error": str(e)})
@@ -264,6 +303,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # included): resubmitting the same prompt with this seed
             # reproduces a sampled answer bit-for-bit.
             "seed": request.seed,
+            "qos": request.qos,
         }
         if request.n > 1:
             body["n"] = request.n
@@ -287,9 +327,14 @@ class ServeServer:
 
     def __init__(self, scheduler: ReplicaScheduler,
                  metrics: Optional[ServeMetrics] = None,
-                 request_timeout_s: Optional[float] = None):
+                 request_timeout_s: Optional[float] = None,
+                 controller=None):
         self.scheduler = scheduler
         self.metrics = metrics or scheduler.metrics
+        # Optional hvdctl FleetController (serve/controller.py): owned
+        # here so start/stop bracket the fleet's lifecycle — the
+        # controller must stop actuating BEFORE the scheduler drains.
+        self.controller = controller
         self.request_timeout_s = (
             request_timeout_s if request_timeout_s is not None
             else float(os.environ.get("HVD_SERVE_REQUEST_TIMEOUT_S", "120")))
@@ -302,6 +347,8 @@ class ServeServer:
 
     def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
         self.scheduler.start()
+        if self.controller is not None:
+            self.controller.start()
         self.httpd = ThreadingHTTPServer((host, port), _ServeHandler)
         self.httpd.daemon_threads = True
         self.httpd.scheduler = self.scheduler
@@ -338,6 +385,11 @@ class ServeServer:
             self._thread.join(timeout=10)
             if not self._thread.is_alive():
                 self._thread = None
+        if self.controller is not None:
+            # Before the scheduler: a controller actuating into a
+            # draining fleet would race mark_dead against the shutdown
+            # drain.
+            self.controller.stop()
         self.scheduler.stop()
         self.metrics.maybe_emit_timeline(force=True)
 
@@ -407,6 +459,12 @@ def run_commandline(argv=None) -> int:
     parser.add_argument("--vocab-size", type=int, default=256,
                         help="mlp model vocab")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--autoscale", action="store_true",
+                        default=os.environ.get(
+                            "HVD_SERVE_CTL_ENABLE", "0")
+                        not in ("0", "false"),
+                        help="run the hvdctl SLO-aware fleet controller "
+                             "(HVD_SERVE_CTL_* knobs, docs/serving.md)")
     args = parser.parse_args(argv)
 
     from .. import core as _core
@@ -419,7 +477,11 @@ def run_commandline(argv=None) -> int:
                                max_batch=args.max_batch)
     if _core._state.timeline is not None:
         scheduler.metrics.set_timeline(_core._state.timeline)
-    server = ServeServer(scheduler)
+    controller = None
+    if args.autoscale:
+        from .controller import FleetController
+        controller = FleetController(scheduler)
+    server = ServeServer(scheduler, controller=controller)
     port = server.start(port=args.port)
     print(f"hvdserve: listening on :{port} — POST /generate, GET /healthz, "
           f"GET /metrics", flush=True)

@@ -59,6 +59,13 @@ SERVE = "SERVE"
 # runs must agree on (docs/fault_injection.md).
 FAULTLINE = "FAULTLINE"
 
+# Brownout rung transitions (serve/controller.py ladder): every rung
+# change the fleet controller walks is an instant event under
+# BROWNOUT/<direction>, so a soak's trace shows exactly when the fleet
+# started degrading, how deep it went, and when it recovered — next to
+# the FAULTLINE instants that caused it.
+BROWNOUT = "BROWNOUT"
+
 # Lock-witness findings (analysis/witness.py, HVD_SANITIZE=1): every
 # observed lock-order inversion / naked wait is an instant event under
 # WITNESS/<rule>, so a sanitized run's trace shows the near-deadlock at
@@ -293,6 +300,18 @@ class Timeline:
         self._put({"name": f"{FAULTLINE}/{kind}", "ph": "i", "s": "p",
                    "ts": self._ts_us(), "pid": self.rank, "tid": point,
                    "args": args})
+
+    def brownout_event(self, direction: str, level: int,
+                       rung: str = ""):
+        """One brownout rung transition (serve/controller.py):
+        process-scoped instant event carrying the walk direction
+        (``up``/``down``), the rung now in effect, and its description
+        — the trace-side record of WHEN the fleet degraded gracefully
+        and when it recovered."""
+        self._put({"name": f"{BROWNOUT}/{direction}", "ph": "i",
+                   "s": "p", "ts": self._ts_us(), "pid": self.rank,
+                   "tid": "hvdctl",
+                   "args": {"level": int(level), "rung": rung}})
 
     def witness_event(self, rule: str, site_path: str, site_line: int,
                       thread_name: str):

@@ -55,6 +55,13 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_NUM_BLOCKS", "HVD_SERVE_MAX_BATCH",
                 "HVD_SERVE_SPEC_K", "HVD_SERVE_DRAFT_LAYERS",
                 "BENCH_SERVE_SPEC_K", "BENCH_SERVE_SAMPLE_TEMP",
+                "BENCH_SERVE_SLO_MS", "HVD_SERVE_CTL_ENABLE",
+                "HVD_SERVE_CTL_SLO_MS", "HVD_SERVE_CTL_MAX_REPLICAS",
+                "HVD_SERVE_CTL_POLL_S", "HVD_SERVE_CTL_MIN_REPLICAS",
+                "HVD_SERVE_CTL_QUEUE_HIGH", "HVD_SERVE_CTL_QUEUE_LOW",
+                "HVD_SERVE_CTL_BROWNOUT_MAX_NEW",
+                "HVD_SERVE_QOS_LAT_QUEUE", "HVD_SERVE_QOS_TPT_QUEUE",
+                "HVD_SERVE_RETRY_AFTER_CAP_S",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
@@ -286,6 +293,20 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert sam["cow_forks"] == 3 and sam["forked_requests"] == 1
         assert sam["pool_share_ratio"] < 1.0
         assert sam["n4_peak_pool_bytes"] < 4 * sam["n1_peak_pool_bytes"]
+        # ISSUE 13: the autoscale arm — a seeded diurnal sweep under the
+        # fleet controller scales up and back down, holds the latency
+        # SLO, and browning out never changes latency-tier outputs.
+        auto = last["autoscale"]
+        for key in ("slo_ms", "slo_held", "latency_p99_ms",
+                    "scale_events", "brownout_seconds",
+                    "max_brownout_level", "shed_throughput",
+                    "outputs_match"):
+            assert key in auto, f"autoscale.{key} missing: {auto}"
+        assert auto["outputs_match"] is True  # brownout ≠ wrong tokens
+        assert auto["slo_held"] is True
+        assert auto["scale_events"]["scale_up"] >= 1
+        assert auto["scale_events"]["scale_down"] >= 1
+        assert auto["brownout_seconds"] >= 0.0
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

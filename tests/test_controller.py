@@ -1,0 +1,525 @@
+"""hvdctl (ISSUE 13): fleet-controller decision tables, QoS admission
+tiers, brownout enforcement, and the load-aware Retry-After hint.
+
+The tentpole's testability contract is that ``decide()`` is a PURE
+function over (config, state, snapshot, now) — the tables here replay
+synthetic stage-latency / queue-depth / kv-headroom sequences through it
+and pin every transition (scale-up, scale-down, brownout rungs,
+hysteresis, cooldowns) with no fleet, no HTTP, no threads.  The
+controller's actuation side (mark_alive / mark_dead / brownout
+propagation onto real batchers and engines) gets a small integration
+smoke on an UNstarted replica pair; the full closed loop under seeded
+diurnal load runs in tests/test_ctl_soak.py.
+"""
+
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import faultline
+from horovod_tpu.faultline import FaultPlan, FaultSpec, diurnal_load
+from horovod_tpu.models import create_mlp
+from horovod_tpu.serve import (ControllerConfig, ControllerState,
+                               DynamicBatcher, FleetController,
+                               FleetSnapshot, InferenceEngine, MLPAdapter,
+                               QueueFullError, Replica, ReplicaScheduler,
+                               Request, ServeMetrics)
+from horovod_tpu.serve.controller import (BROWNOUT_MAX_LEVEL, decide,
+                                          windowed_p99)
+
+VOCAB = 31
+
+
+def _mlp_adapter(seed=3):
+    mlp = create_mlp(features=(16, VOCAB))
+    params = mlp.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, VOCAB)))["params"]
+    return MLPAdapter(mlp, params, vocab_size=VOCAB, max_len=128)
+
+
+def _cfg(**kw):
+    """Fast-reacting config for the tables; tests override per-case."""
+    base = dict(poll_s=0.1, min_replicas=1, max_replicas=8,
+                queue_high=8.0, queue_low=1.0, up_polls=3, down_polls=4,
+                up_cooldown_s=0.0, down_cooldown_s=0.0,
+                brownout_polls=2, brownout_clear_polls=3)
+    base.update(kw)
+    return ControllerConfig(**base).validate()
+
+
+def _hot(healthy=2, spares=1, queued=100, **kw):
+    return FleetSnapshot(healthy=healthy, spares=spares, queued=queued,
+                         **kw)
+
+
+def _idle(healthy=2, spares=1, queued=0, **kw):
+    return FleetSnapshot(healthy=healthy, spares=spares, queued=queued,
+                         **kw)
+
+
+def _run(cfg, snaps, state=None, t0=0.0, dt=1.0):
+    """Replay a snapshot sequence through decide(); returns the action
+    list per poll (the table format every test below asserts on)."""
+    state = state or ControllerState()
+    return state, [decide(cfg, state, s, t0 + i * dt)
+                   for i, s in enumerate(snaps)]
+
+
+# -- decide(): scale-up ------------------------------------------------------
+
+def test_scale_up_after_sustained_pressure_only():
+    cfg = _cfg(up_polls=3)
+    _, actions = _run(cfg, [_hot()] * 4)
+    assert actions == [[], [], ["scale_up"], []]
+
+
+def test_pressure_blip_resets_hysteresis():
+    # 2 hot polls, one dead-band poll (neither hot nor idle), 2 more hot:
+    # the counter restarted, so no scale-up yet.
+    cfg = _cfg(up_polls=3)
+    blip = [_hot(), _hot(), _hot(queued=8),  # 8/2 = 4: dead band
+            _hot(), _hot()]
+    _, actions = _run(cfg, blip)
+    assert actions == [[], [], [], [], []]
+
+
+def test_up_cooldown_blocks_then_fires_immediately_on_expiry():
+    cfg = _cfg(up_polls=2, up_cooldown_s=3.5)
+    state, actions = _run(cfg, [_hot()] * 8, dt=1.0)
+    # Fires at t=1 (2nd hot poll), cooldown blocks t=2..4 (< 1+3.5),
+    # fires again the very first eligible poll (t=5) without needing a
+    # fresh up_polls run — hot_polls is deliberately not reset while the
+    # cooldown holds the action back.
+    assert actions == [[], ["scale_up"], [], [], [],
+                       ["scale_up"], [], []]
+    assert state.last_scale_up_t == 5.0
+
+
+@pytest.mark.parametrize("snap", [
+    # Each pressure source alone must trip the controller: queue depth,
+    # windowed latency-tier p99 >= SLO, kv headroom under the floor.
+    _hot(queued=100),
+    FleetSnapshot(healthy=2, spares=1, queued=0, latency_p99_ms=900.0),
+    FleetSnapshot(healthy=2, spares=1, queued=0,
+                  kv_headroom_bytes=1 << 10),
+])
+def test_every_pressure_source_scales_up(snap):
+    cfg = _cfg(up_polls=2, slo_ms=500.0, headroom_min_bytes=1 << 20)
+    _, actions = _run(cfg, [snap] * 2)
+    assert actions == [[], ["scale_up"]]
+
+
+def test_disabled_slo_and_headroom_are_ignored():
+    cfg = _cfg(up_polls=1, slo_ms=0.0, headroom_min_bytes=0)
+    snap = FleetSnapshot(healthy=2, spares=1, queued=0,
+                         latency_p99_ms=10_000.0, kv_headroom_bytes=1)
+    _, actions = _run(cfg, [snap] * 3)
+    assert actions == [[], [], []]
+
+
+# -- decide(): scale-down ----------------------------------------------------
+
+def test_scale_down_after_sustained_idleness():
+    cfg = _cfg(down_polls=4)
+    _, actions = _run(cfg, [_idle()] * 5)
+    assert actions == [[], [], [], ["scale_down"], []]
+
+
+def test_scale_down_guards_min_replicas():
+    cfg = _cfg(down_polls=2, min_replicas=2)
+    _, actions = _run(cfg, [_idle(healthy=2)] * 6)
+    assert all(a == [] for a in actions)
+
+
+def test_scale_down_cooldown():
+    cfg = _cfg(down_polls=2, down_cooldown_s=3.5, min_replicas=1)
+    _, actions = _run(cfg, [_idle(healthy=4)] * 9, dt=1.0)
+    # Fires at t=1, cooldown blocks t=2..4 (< 1+3.5), fires again the
+    # first eligible poll (t=5) — the idle counter keeps accumulating
+    # while the cooldown holds the action back.
+    assert actions == [[], ["scale_down"], [], [], [],
+                       ["scale_down"], [], [], []]
+
+
+def test_dead_band_resets_idle_counter():
+    cfg = _cfg(down_polls=2)
+    _, actions = _run(cfg, [_idle(), _hot(queued=8),  # dead band
+                            _idle(), _idle()])
+    assert actions == [[], [], [], ["scale_down"]]
+
+
+# -- decide(): brownout ladder -----------------------------------------------
+
+def test_brownout_only_when_envelope_exhausted():
+    # Spares available: pressure scales up, never browns out.
+    cfg = _cfg(up_polls=1, brownout_polls=1)
+    _, actions = _run(cfg, [_hot(healthy=2, spares=3)] * 4)
+    assert all(a == ["scale_up"] for a in actions)
+
+
+@pytest.mark.parametrize("snap", [
+    _hot(healthy=8, spares=3),   # at max_replicas
+    _hot(healthy=2, spares=0),   # out of spares
+])
+def test_brownout_climbs_when_stuck(snap):
+    cfg = _cfg(up_polls=2, brownout_polls=2)
+    state, actions = _run(cfg, [snap] * 12)
+    # up_polls gates entry (stuck counting starts at poll 1), then one
+    # rung per brownout_polls stuck observations: rungs at polls 2, 4,
+    # 6, 8 — and the ladder stops at BROWNOUT_MAX_LEVEL (no 5th rung).
+    fired = [i for i, a in enumerate(actions) if a == ["brownout_up"]]
+    assert fired == [2, 4, 6, 8]
+    assert state.brownout_level == BROWNOUT_MAX_LEVEL
+
+
+def test_brownout_descends_with_own_hysteresis_then_scales_down():
+    cfg = _cfg(up_polls=1, brownout_polls=1, brownout_clear_polls=3,
+               down_polls=2)
+    state = ControllerState()
+    # Drive to rung 2 (stuck at the envelope: with up_polls and
+    # brownout_polls both 1, every hot poll climbs one rung), then
+    # clear the pressure.
+    _, up = _run(cfg, [_hot(healthy=8, spares=0)] * 2, state=state)
+    assert up == [["brownout_up"], ["brownout_up"]]
+    assert state.brownout_level == 2
+    _, down = _run(cfg, [_idle(healthy=8)] * 7, state=state, t0=100.0)
+    # One rung per brownout_clear_polls clear polls; scale_down stays
+    # suppressed until the ladder is fully off (level 0 at poll 5 —
+    # idle-counter runway then allows the first shrink at that poll).
+    assert down == [[], [], ["brownout_down"], [], [],
+                    ["brownout_down", "scale_down"], []]
+    assert state.brownout_level == 0
+
+
+def test_brownout_descent_interrupted_by_pressure():
+    cfg = _cfg(up_polls=1, brownout_polls=1, brownout_clear_polls=2)
+    state = ControllerState()
+    _run(cfg, [_hot(healthy=8, spares=0)], state=state)
+    assert state.brownout_level == 1
+    # clear, clear-but-then-hot: the clear counter must restart.
+    _, actions = _run(cfg, [_idle(healthy=8), _hot(healthy=8, spares=0),
+                            _idle(healthy=8), _idle(healthy=8)],
+                      state=state, t0=50.0)
+    assert actions[0] == [] and actions[1] == ["brownout_up"]
+    assert actions[2] == [] and actions[3] == ["brownout_down"]
+
+
+# -- config + windowed p99 ---------------------------------------------------
+
+def test_controller_config_validate_rejects_bad_envelopes():
+    with pytest.raises(ValueError, match="min_replicas"):
+        ControllerConfig(min_replicas=0).validate()
+    with pytest.raises(ValueError, match="max_replicas"):
+        ControllerConfig(min_replicas=4, max_replicas=2).validate()
+    with pytest.raises(ValueError, match="hysteresis"):
+        ControllerConfig(queue_low=9, queue_high=8).validate()
+    with pytest.raises(ValueError, match="poll_s"):
+        ControllerConfig(poll_s=0).validate()
+
+
+def test_controller_config_from_env(monkeypatch):
+    monkeypatch.setenv("HVD_SERVE_CTL_SLO_MS", "250")
+    monkeypatch.setenv("HVD_SERVE_CTL_MAX_REPLICAS", "12")
+    monkeypatch.setenv("HVD_SERVE_CTL_BROWNOUT_MAX_NEW", "48")
+    cfg = ControllerConfig.from_env()
+    assert (cfg.slo_ms, cfg.max_replicas, cfg.brownout_max_new) == \
+        (250.0, 12, 48)
+
+
+def test_windowed_p99_diffs_cumulative_buckets():
+    bounds = [1.0, 5.0, 25.0]
+    # Empty window: no observations between polls.
+    assert windowed_p99(bounds, [3, 3, 3], [3, 3, 3], 3, 3) is None
+    # 3 new observations, all <= 5ms: windowed p99 is 5, even though the
+    # CUMULATIVE histogram still remembers an old 25ms spike.
+    assert windowed_p99(bounds, [0, 0, 3], [0, 3, 6], 3, 6) == 5.0
+    # First poll (no previous counts): whole histogram is the window.
+    assert windowed_p99(bounds, None, [0, 0, 4], 0, 4) == 25.0
+    # Above the top bucket: clamps to the last bound.
+    assert windowed_p99(bounds, [0, 0, 0], [0, 0, 0], 0, 2) == 25.0
+
+
+# -- QoS tiers in the batcher ------------------------------------------------
+
+def test_request_rejects_unknown_qos_tier():
+    with pytest.raises(ValueError, match="qos"):
+        Request([1], qos="bulk")
+
+
+def test_edf_ordering_requeued_then_latency_then_deadline():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=0)
+    tpt = Request([1], qos="throughput")
+    lat_late = Request([2], qos="latency", timeout_s=60)
+    lat_soon = Request([3], qos="latency", timeout_s=5)
+    lat_fifo = Request([4], qos="latency")  # deadline-less
+    redo = Request([5], qos="throughput")
+    redo.requeues = 1  # drained off a dead replica
+    for r in (tpt, lat_late, lat_soon, lat_fifo):
+        b.submit(r)
+    b.requeue_front([redo])
+    got = b.get_admission(8)
+    assert [r.request_id for r in got] == [
+        redo.request_id,      # requeued work outranks everything
+        lat_soon.request_id,  # EDF within the latency tier
+        lat_late.request_id,
+        lat_fifo.request_id,  # deadline-less latency after deadlines
+        tpt.request_id]       # throughput tier last
+
+
+def test_deadline_less_single_tier_traffic_keeps_exact_fifo():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=0)
+    reqs = [Request([i + 1]) for i in range(6)]
+    for r in reqs:
+        b.submit(r)
+    got = b.get_admission(6)
+    assert [r.request_id for r in got] == [r.request_id for r in reqs]
+
+
+def test_per_tier_queue_bounds():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=1000)
+    b.tier_bounds["throughput"] = 2
+    b.submit(Request([1], qos="throughput"))
+    b.submit(Request([2], qos="throughput"))
+    with pytest.raises(QueueFullError, match="throughput tier"):
+        b.submit(Request([3], qos="throughput"))
+    b.submit(Request([4], qos="latency"))  # other tier unaffected
+    assert b.depth() == 3
+
+
+# -- brownout rung enforcement ----------------------------------------------
+
+def test_brownout_l1_sheds_new_throughput_submissions():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=1000)
+    b.brownout_level = 1
+    with pytest.raises(QueueFullError, match="throughput tier shed"):
+        b.submit(Request([1], qos="throughput"))
+    b.submit(Request([2], qos="latency"))  # latency tier unaffected
+    assert b.depth() == 1
+
+
+def test_brownout_l2_caps_max_new_tokens_at_take_time():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=0)
+    b.submit(Request([1], max_new_tokens=64))
+    b.submit(Request([2], max_new_tokens=4))
+    b.brownout_max_new = 8
+    seen_costs = []
+
+    def cost(r):
+        seen_costs.append(r.max_new_tokens)
+        return 1
+
+    got = b.get_admission(4, budget=100, cost=cost)
+    # Capped BEFORE cost() ran: admission accounting, block allocation,
+    # and fork-tail reserves all see the capped lifetime.
+    assert [r.max_new_tokens for r in got] == [8, 4]
+    assert seen_costs == [8, 4]
+
+
+def test_brownout_l3_rejects_fork_requests():
+    b = DynamicBatcher(max_queue=16, max_wait_ms=1000)
+    b.brownout_level = 3
+    with pytest.raises(QueueFullError, match="n>1 forking"):
+        b.submit(Request([1], temperature=0.5, n=4, seed=7))
+    b.submit(Request([2], temperature=0.5, n=1, seed=7))
+
+
+def test_brownout_l4_purges_queued_throughput_work():
+    shed = []
+    b = DynamicBatcher(max_queue=16, max_wait_ms=0,
+                       on_shed=lambda r, why: shed.append((r, why)))
+    lat = Request([1], qos="latency")
+    tp1 = Request([2], qos="throughput")
+    tp2 = Request([3], qos="throughput")
+    for r in (tp1, lat, tp2):
+        b.submit(r)
+    b.brownout_level = 4
+    got = b.get_admission(8)
+    assert got == [lat]
+    assert sorted(r.request_id for r, _ in shed) == \
+        sorted([tp1.request_id, tp2.request_id])
+    assert all(why == "shed" for _, why in shed)
+    for r in (tp1, tp2):
+        with pytest.raises(QueueFullError, match="latency-tier-only"):
+            r.result(timeout=1)
+
+
+# -- load-aware Retry-After (satellite: server hint regression) --------------
+
+def _handler_for(metrics, healthy=2):
+    """A detached _ServeHandler with just enough server context for the
+    hint math (no sockets — the regression is about the formula)."""
+    from horovod_tpu.serve.server import _ServeHandler
+    h = object.__new__(_ServeHandler)
+    fleet = [types.SimpleNamespace(state="healthy")] * healthy + \
+        [types.SimpleNamespace(state="dead")]
+    h.server = types.SimpleNamespace(
+        metrics=metrics,
+        scheduler=types.SimpleNamespace(fleet=lambda: list(fleet)))
+    return h
+
+
+def test_retry_after_derives_from_queue_drain_rate(monkeypatch):
+    m = ServeMetrics()
+    h = _handler_for(m, healthy=2)
+    # No queue and no service history: the old flat hint.
+    assert h._retry_after_s() == 1
+    # 12 queued x 2s EWMA service time over 2 replicas = 12s, capped at
+    # the default HVD_SERVE_RETRY_AFTER_CAP_S=8.
+    m.register_queue_depth("r0", lambda: 7)
+    m.register_queue_depth("r1", lambda: 5)
+    m.observe_request_ms("latency", 2000.0)
+    assert h._retry_after_s() == 8
+    monkeypatch.setenv("HVD_SERVE_RETRY_AFTER_CAP_S", "30")
+    assert h._retry_after_s() == 12
+    # Shallower queue: the hint scales down with the drain estimate.
+    m.register_queue_depth("r0", lambda: 1)
+    m.register_queue_depth("r1", lambda: 1)
+    assert h._retry_after_s() == 2
+
+
+def test_retry_after_capped_by_client_deadline_budget(monkeypatch):
+    m = ServeMetrics()
+    h = _handler_for(m, healthy=1)
+    m.register_queue_depth("r0", lambda: 10)
+    m.observe_request_ms("latency", 1000.0)
+    monkeypatch.setenv("HVD_SERVE_RETRY_AFTER_CAP_S", "60")
+    assert h._retry_after_s() == 10
+    # A client with 3s of budget left must not be told to sleep 10.
+    headers = dict(h._budget_headers(Request([1], timeout_s=3.0)))
+    assert int(headers["Retry-After"]) <= 3
+    assert float(headers["X-Deadline-Remaining-S"]) <= 3.0
+    # Deadline-less requests get the raw availability hint.
+    headers = dict(h._budget_headers(Request([1])))
+    assert headers["Retry-After"] == "10"
+    assert "X-Deadline-Remaining-S" not in headers
+
+
+# -- faultline: load-spike + diurnal load shape ------------------------------
+
+def test_diurnal_load_is_seeded_and_diurnal():
+    a = diurnal_load(24, peak=40, base=2, seed=9)
+    assert a == diurnal_load(24, peak=40, base=2, seed=9)  # pure
+    assert a != diurnal_load(24, peak=40, base=2, seed=10)
+    assert len(a) == 24 and all(v >= 0 for v in a)
+    mid = sum(a[8:16]) / 8
+    edges = (sum(a[:4]) + sum(a[-4:])) / 8
+    assert mid > edges  # low -> peak -> low
+    with pytest.raises(ValueError):
+        diurnal_load(0, peak=4)
+    with pytest.raises(ValueError):
+        diurnal_load(4, peak=2, base=5)
+    with pytest.raises(ValueError):
+        diurnal_load(4, peak=2, jitter=1.5)
+
+
+def test_load_spike_spec_defaults_to_ctl_poll_point():
+    spec = faultline.parse_spec("load-spike~16*2")
+    assert spec.kind == "load-spike"
+    assert spec.point == "ctl.poll"
+    assert spec.param == 16.0 and spec.repeat == 2
+
+
+def test_controller_consumes_load_spike_through_injector():
+    bursts = []
+    sched = types.SimpleNamespace(fleet=lambda: [],
+                                  metrics=ServeMetrics())
+    ctl = FleetController(sched, config=_cfg(),
+                          load_injector=lambda n: bursts.append(n) or n)
+    plan = FaultPlan([FaultSpec("load-spike", step=1, repeat=2,
+                                param=5.0)], seed=3)
+    faultline.install(plan)
+    try:
+        for _ in range(4):
+            ctl.poll()
+        assert plan.exhausted()
+        assert bursts == [5, 5]
+    finally:
+        faultline.uninstall()
+
+
+# -- FleetController integration (real scheduler, unstarted engines) ---------
+
+def _fleet(n=2, metrics=None):
+    metrics = metrics or ServeMetrics()
+    reps = [Replica(f"replica-{i}", None,
+                    InferenceEngine(_mlp_adapter(), max_batch=4,
+                                    replica_id=f"replica-{i}"))
+            for i in range(n)]
+    return ReplicaScheduler(reps, metrics=metrics), reps
+
+
+def test_controller_revives_dead_spare_then_shrinks_when_idle():
+    sched, reps = _fleet(2)
+    sched.mark_dead("replica-1", reason="test setup")
+    cfg = _cfg(up_polls=1, down_polls=2, queue_high=2.0,
+               min_replicas=1, max_replicas=4)
+    ctl = FleetController(sched, config=cfg, metrics=sched.metrics)
+    for _ in range(3):
+        reps[0].engine.batcher.submit(Request([1]))
+    assert ctl.poll() == ["scale_up"]
+    assert reps[1].state == "healthy"  # spare revived via mark_alive
+    reps[0].engine.batcher.drain()
+    assert ctl.poll() == []            # idle hysteresis: 1 of 2 polls
+    assert ctl.poll() == ["scale_down"]
+    assert sum(1 for r in sched.fleet() if r.state == "healthy") == 1
+    assert ctl.stats()["scale_events"]["scale_up"] == 1
+    assert ctl.stats()["scale_events"]["scale_down"] == 1
+
+
+def test_controller_propagates_brownout_to_batchers_and_engines():
+    sched, reps = _fleet(2)
+    cfg = _cfg(up_polls=1, brownout_polls=1, brownout_clear_polls=1,
+               queue_high=1.0, max_replicas=2)  # at envelope, no spares
+    ctl = FleetController(sched, config=cfg, metrics=sched.metrics)
+    for _ in range(4):
+        reps[0].engine.batcher.submit(Request([1]))
+    # up_polls = brownout_polls = 1: every stuck poll climbs one rung.
+    assert ctl.poll() == ["brownout_up"]
+    assert ctl.poll() == ["brownout_up"]    # rung 2: max_new cap engages
+    for r in reps:
+        assert r.engine.batcher.brownout_level == 2
+        assert r.engine.batcher.brownout_max_new == cfg.brownout_max_new
+        assert r.engine.brownout_level == 2
+    assert sched.metrics.snapshot()["brownout_level"] == 2
+    with pytest.raises(QueueFullError):
+        reps[0].engine.batcher.submit(Request([9], qos="throughput"))
+    reps[0].engine.batcher.drain()
+    assert ctl.poll() == ["brownout_down"]
+    assert ctl.poll() == ["brownout_down"]
+    for r in reps:
+        assert r.engine.batcher.brownout_level == 0
+        assert r.engine.batcher.brownout_max_new == 0
+    assert ctl.stats()["brownout_level"] == 0
+    assert ctl.stats()["brownout_seconds"] >= 0.0
+    events = sched.metrics.snapshot()["ctl_events"]
+    assert events["brownout_up"] == 2 and events["brownout_down"] == 2
+
+
+def test_controller_thread_lifecycle_and_poll_error_recovery():
+    sched, _ = _fleet(1)
+    cfg = _cfg(poll_s=0.01)
+    ctl = FleetController(sched, config=cfg, metrics=sched.metrics)
+    broken = {"n": 0}
+    real_snapshot = ctl.snapshot
+
+    def flaky_snapshot():
+        broken["n"] += 1
+        if broken["n"] == 1:
+            raise RuntimeError("injected snapshot failure")
+        return real_snapshot()
+
+    ctl.snapshot = flaky_snapshot
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 10
+        while broken["n"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert broken["n"] >= 3, "poll loop died after one error"
+    finally:
+        ctl.stop()
+    assert ctl._thread is None
+    assert sched.metrics.snapshot()["ctl_events"]["poll_error"] == 1

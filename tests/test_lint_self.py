@@ -46,9 +46,11 @@ def test_lint_covers_the_whole_tree():
                    if os.sep + os.path.join("serve", "") in f]
     # sampling.py (ISSUE 11) carries the serving PRNG discipline the new
     # HVD010 rule audits — it must stay inside the gate's walk.
+    # controller.py (ISSUE 13) holds the fleet control plane — the
+    # autoscale/brownout decision loop must stay under the same lint.
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
                 "server.py", "metrics.py", "paged_attention.py",
-                "sampling.py"):
+                "sampling.py", "controller.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under

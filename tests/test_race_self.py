@@ -74,6 +74,10 @@ def test_race_walk_covers_the_threaded_tree():
     # checked only if the walker visits it.
     assert any(f.endswith(os.path.join("serve", "sampling.py"))
                for f in files), "serve/sampling.py not analyzed"
+    # The fleet controller (ISSUE 13) polls replica locks from its own
+    # thread — the walker must see it for the registry check below.
+    assert any(f.endswith(os.path.join("serve", "controller.py"))
+               for f in files), "serve/controller.py not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")
@@ -88,7 +92,7 @@ def test_race_walk_covers_the_threaded_tree():
                   "InferenceEngine._lock", "ReplicaScheduler._lock",
                   "BlockManager._lock", "ElasticDriver._lock",
                   "Negotiator._buf_lock", "Negotiator._flush_lock",
-                  "Tracer._lock"):
+                  "Tracer._lock", "FleetController._lock"):
         assert label in analyzer.lock_sites, \
             f"{label} missing from the witness registry"
     # Condition-wraps-lock aliasing: the batcher's _cond must NOT appear

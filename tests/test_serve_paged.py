@@ -739,3 +739,54 @@ def test_mark_dead_during_chunked_prefill_requeues_and_frees_blocks():
         assert metrics.snapshot()["requests"]["requeued"] >= 1
     finally:
         sched.stop()
+
+
+def test_mark_dead_idle_replica_refunds_reserves_and_requeues_nothing():
+    """hvdctl's scale-down drain (controller._scale_down): marking an
+    IDLE replica dead must be work-free — zero requests requeued onto
+    survivors — and must leave the pool fully refunded: no used blocks
+    and no outstanding fork-family reserves (an n>1 request's decode
+    tails are RESERVED at admission, not allocated, so a leak here
+    would silently shrink every later admission budget)."""
+    from horovod_tpu.serve import Replica, ReplicaScheduler
+    _, params = _tiny()
+    metrics = ServeMetrics()
+    victim_eng = InferenceEngine(
+        TransformerAdapter(_TINY, params, block_tokens=BT),
+        kv_mode="paged", prefill_chunk=5, max_batch=8, metrics=metrics,
+        replica_id="victim")
+    survivor_eng = InferenceEngine(
+        TransformerAdapter(_TINY, params, block_tokens=BT),
+        kv_mode="paged", prefill_chunk=5, max_batch=8, metrics=metrics,
+        replica_id="survivor")
+    sched = ReplicaScheduler(
+        [Replica("victim", None, victim_eng),
+         Replica("survivor", None, survivor_eng)], metrics=metrics).start()
+    try:
+        # Run a fork family (n=2 reserves decode tails) and a greedy
+        # request through the victim, to completion.
+        forked = Request([1, 2, 3, 4], max_new_tokens=6,
+                         temperature=0.8, n=2, seed=11)
+        plain = Request([5, 6, 7], max_new_tokens=4)
+        victim_eng.batcher.submit(forked)
+        victim_eng.batcher.submit(plain)
+        assert len(forked.result(timeout=120)) == 6
+        assert len(plain.result(timeout=120)) == 4
+        deadline = time.monotonic() + 30
+        while victim_eng.active_count > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert victim_eng.active_count == 0 and \
+            victim_eng.batcher.depth() == 0, "victim never went idle"
+
+        requeued_before = metrics.snapshot()["requests"]["requeued"]
+        sched.mark_dead("victim", reason="hvdctl: sustained idleness")
+
+        # Work-free shrink: nothing moved to the survivor.
+        assert metrics.snapshot()["requests"]["requeued"] == requeued_before
+        assert survivor_eng.batcher.depth() == 0
+        # Full refund: no used blocks (retained prefix blocks are fine —
+        # refcount 0), no outstanding fork-family reserves.
+        assert victim_eng.kv_stats()["used"] == 0
+        assert victim_eng._reserved_blocks() == 0
+    finally:
+        sched.stop()
