@@ -66,7 +66,14 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_FAULTLINE_SEED": "0",
                  "HVD_FAULTLINE_PLAN": "",
                  "HVD_TRACE_SAMPLE": "0",
-                 "HVD_TRACE_DIR": ""}
+                 "HVD_TRACE_DIR": "",
+                 "HVD_SERVE_TENANT_WEIGHTS": "",
+                 "HVD_SERVE_TENANT_QUEUE": "0",
+                 "HVD_SERVE_TENANT_TOKENS": "0",
+                 "HVD_SERVE_TENANT_QUANTUM": "64",
+                 "HVD_SERVE_TENANT_MAX_LABELS": "32",
+                 "HVD_SERVE_COMPILE_CACHE": "",
+                 "HVD_SERVE_WARMUP": "0"}
 
 
 def _last_good_path():
@@ -1099,6 +1106,155 @@ def bench_serve():
         "outputs_match": ctl_outs == outs,
     }
 
+    # -- arm 9: multitenant — hvdtenant platform (ISSUE 15) -------------------
+    # Two model variants resident on a small fleet, three tenants at
+    # weights 3:2:1 driving a saturating storm (max_batch=2 keeps a
+    # visible backlog, so WDRR admission IS the goodput dial), with a
+    # live roll of the second variant mid-storm.  Recorded acceptance
+    # numbers: per-tenant fair-share ratio (observed early-goodput share
+    # / weight share), swap_zero_failures (every storm request
+    # succeeded across the roll), post-roll bit-exactness vs the new
+    # weights served cold, and the revived-replica cold-start
+    # (warmup ms + first-request latency vs the storm's steady p50).
+    from horovod_tpu.models import create_mlp
+    from horovod_tpu.serve import (DynamicBatcher, MLPAdapter,
+                                   ModelRegistry, Replica, ReplicaScheduler,
+                                   TenantConfig)
+    mt_vocab = 61
+
+    def _mt_adapter(seed):
+        mlp_mod = create_mlp(features=(32, mt_vocab))
+        p = mlp_mod.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, mt_vocab)))["params"]
+        return MLPAdapter(mlp_mod, p, vocab_size=mt_vocab, max_len=64)
+
+    mt_weights = {"gold": 3.0, "silver": 2.0, "bronze": 1.0}
+    mt_cfg_t = TenantConfig(weights=mt_weights, quantum=8)
+    mt_metrics = ServeMetrics()
+    n_mt = 2 if smoke else 4
+    per_tenant = 6 if smoke else 12
+    mt_tokens = max(min(new_tokens, 8), 2)
+    mt_replicas = []
+    for i in range(n_mt):
+        eng = InferenceEngine(
+            _mt_adapter(3), batcher=DynamicBatcher(tenants=mt_cfg_t),
+            metrics=mt_metrics, max_batch=2, kv_mode="paged",
+            replica_id=f"mt-{i}", warmup=True)
+        mt_replicas.append(Replica(f"mt-{i}", None, eng))
+    mt_sched = ReplicaScheduler(mt_replicas, metrics=mt_metrics)
+    registry = ModelRegistry(mt_sched, metrics=mt_metrics)
+    registry.adopt("default")
+    registry.register("tuned", adapter=_mt_adapter(7))
+    mt_sched.start()
+    mt_prompt = [1, 2, 3, 4, 5, 6]
+
+    def mt_storm(with_models):
+        """One interleaved-arrival storm; returns (requests, stamps,
+        failures).  Completion stamps come from a poll loop (Request
+        carries no finish time) — 1 ms granularity is far below a
+        decode pass here, so completion ORDER is preserved."""
+        reqs = []
+        for j in range(per_tenant):
+            for tenant in mt_weights:  # interleaved, no head start
+                mdl = "tuned" if with_models and j % 3 == 2 else None
+                reqs.append(Request(list(mt_prompt),
+                                    max_new_tokens=mt_tokens,
+                                    tenant=tenant, model=mdl))
+        for r in reqs:
+            mt_sched.submit(r)
+        return reqs
+
+    def mt_collect(reqs):
+        stamp = {}
+        deadline = time.monotonic() + 600
+        while len(stamp) < len(reqs) and time.monotonic() < deadline:
+            now = time.perf_counter()
+            for i, r in enumerate(reqs):
+                if i not in stamp and r.done:
+                    stamp[i] = now
+            if len(stamp) < len(reqs):
+                time.sleep(0.001)
+        done, failures = [], 0
+        for i, r in enumerate(reqs):
+            try:
+                out = r.result(timeout=60)
+                done.append((stamp.get(i, time.perf_counter()), r.tenant,
+                             len(out)))
+            except Exception:
+                failures += 1
+        return done, failures
+
+    # Fairness storm (no roll churn: a mid-storm roll requeues orphans
+    # into the requeued-first priority class, which would scramble the
+    # very ordering under measurement).
+    mt_t0 = time.perf_counter()
+    fair_reqs = mt_storm(with_models=False)
+    mt_done, fair_failures = mt_collect(fair_reqs)
+    # Early-goodput share: tokens per tenant over the first HALF of
+    # completions — under saturation the DRR quantum ratio, not arrival
+    # order, decides who lands there.
+    mt_done.sort(key=lambda x: x[0])
+    half = mt_done[:max(len(mt_done) // 2, 1)]
+    share = {t: 0 for t in mt_weights}
+    for _, tenant, toks in half:
+        share[tenant] += toks
+    total_share = max(sum(share.values()), 1)
+    wsum = sum(mt_weights.values())
+    fair_ratio = {
+        t: round((share[t] / total_share) / (mt_weights[t] / wsum), 3)
+        for t in mt_weights}
+    # Swap storm: live roll mid-storm — replica-by-replica
+    # drain -> swap -> revive while requests (both variants) drain;
+    # orphaned work requeues onto holders of the same variant, so zero
+    # requests may fail.
+    swap_reqs = mt_storm(with_models=True)
+    registry.roll("tuned", adapter=_mt_adapter(11))
+    _, mt_failures = mt_collect(swap_reqs)
+    mt_failures += fair_failures
+    # Post-roll exactness: the rolled variant served by the fleet must
+    # equal the new weights served COLD by a fresh engine.
+    post = Request(list(mt_prompt), max_new_tokens=mt_tokens,
+                   model="tuned")
+    mt_sched.submit(post)
+    post_out = post.result(timeout=600)
+    cold_eng = InferenceEngine(_mt_adapter(11),
+                               batcher=DynamicBatcher(),
+                               metrics=ServeMetrics(), max_batch=2,
+                               kv_mode="paged",
+                               replica_id="mt-cold").start()
+    cold_req = Request(list(mt_prompt), max_new_tokens=mt_tokens)
+    cold_eng.batcher.submit(cold_req)
+    cold_out = cold_req.result(timeout=600)
+    cold_eng.stop()
+    # Cold-start: revive a replica (the controller-grown path) — warmup
+    # re-runs at start() and the first request onto the warm replica is
+    # compared against the storm's steady per-request latency.
+    steady = sorted(t - mt_t0 for t, _, _ in mt_done)
+    steady_p50_s = steady[len(steady) // 2] if steady else 0.0
+    mt_sched.mark_dead("mt-0", reason="bench cold-start probe")
+    mt_sched.mark_alive("mt-0", reason="bench cold-start probe")
+    cold_ms = mt_replicas[0].engine.last_warmup_ms
+    probe = Request(list(mt_prompt), max_new_tokens=mt_tokens)
+    p_t0 = time.perf_counter()
+    mt_sched.submit(probe)
+    probe.result(timeout=600)
+    first_request_ms = (time.perf_counter() - p_t0) * 1e3
+    mt_sched.stop()
+    mt_snap = mt_metrics.snapshot()
+    arm_multitenant = {
+        "replicas": n_mt,
+        "tenants": {t: w for t, w in mt_weights.items()},
+        "fair_share_ratio": fair_ratio,
+        "swap_zero_failures": mt_failures == 0,
+        "swap_progress": mt_snap["swap"],
+        "post_roll_exact": post_out == cold_out,
+        "cold_start_ms": round(cold_ms, 3),
+        "warmup_runs": mt_replicas[0].engine.warmup_runs,
+        "first_request_ms": round(first_request_ms, 3),
+        "tenant_requests": {t: mt_snap["tenants"].get(t, {}).get(
+            "requests", {}) for t in mt_weights},
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -1134,6 +1290,7 @@ def bench_serve():
         "spec": arm_spec,
         "sampling": arm_sampling,
         "autoscale": arm_autoscale,
+        "multitenant": arm_multitenant,
     })
 
 

@@ -118,6 +118,21 @@ def load_model(path: str, optimizer=None, params_template: Any = None,
     return restored["params"], opt, restored.get("opt_state"), extra or {}
 
 
+def load_params(path: str, template: Optional[Any] = None) -> Any:
+    """Serving-plane load: read just the params tree from a checkpoint
+    written by :func:`save_model` (or a bare :func:`save` of params),
+    WITHOUT requiring ``hvd.init()`` or broadcasting — the model
+    registry's hot-swap path (serve/registry.py) loads new weights on
+    whatever host runs the roll, and each replica's swap installs the
+    same host arrays.  Accepts either layout: a ``{"params": ...,
+    "opt_state": ...}`` tree or a params-only tree."""
+    restored = _ckptr().restore(os.path.abspath(path), item=template)
+    restored = jax.tree_util.tree_map(jax.numpy.asarray, restored)
+    if isinstance(restored, dict) and "params" in restored:
+        return restored["params"]
+    return restored
+
+
 def restore(path: str, template: Optional[Any] = None,
             broadcast: bool = True) -> Any:
     """Load on rank 0 and broadcast to every rank (broadcast_variables

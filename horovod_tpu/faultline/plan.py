@@ -42,11 +42,11 @@ from typing import Dict, List, Optional, Tuple
 
 #: Fault kinds (docs/fault_injection.md has the per-kind semantics).
 KINDS = ("kill-rank", "delay-kv", "drop-kv-response", "poison-step",
-         "slow-decode", "pool-corrupt-block", "load-spike")
+         "slow-decode", "pool-corrupt-block", "load-spike", "swap-abort")
 
 #: Injection points threaded through the codebase.
 POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll",
-          "ctl.poll")
+          "ctl.poll", "registry.roll")
 
 #: Default injection point per kind (a spec may override, e.g. kill-rank
 #: at replica.route fires report_rank_lost directly instead of going
@@ -63,6 +63,10 @@ DEFAULT_POINT = {
     # overload the autoscaler/brownout ladder must absorb, as a seeded
     # scheduled fault rather than wall-clock client chance.
     "load-spike": "ctl.poll",
+    # Kill a live weight rollout mid-fleet (serve/registry.py roll):
+    # fires BEFORE the next replica is touched, so the half-rolled fleet
+    # keeps serving both versions and the roll stays resumable.
+    "swap-abort": "registry.roll",
 }
 
 #: Step-assignment window for specs without an explicit ``@step``: drawn

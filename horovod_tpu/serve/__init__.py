@@ -21,6 +21,10 @@ Layers (docs/serving.md has the architecture):
 * :mod:`replica` — process-set replicas, least-loaded routing, failover;
 * :mod:`controller` — hvdctl: SLO-aware autoscaling + the brownout
   ladder (docs/serving.md control plane);
+* :mod:`tenancy`  — hvdtenant: per-tenant quotas + weighted
+  deficit-round-robin fairness under the QoS ordering;
+* :mod:`registry` — hvdtenant: named model variants (full weights or
+  adapter deltas), variant routing, live rolling weight swap;
 * :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
   ``hvdserve`` CLI;
 * :mod:`metrics` — TTFT / per-token histograms, occupancy, tokens/s.
@@ -64,7 +68,13 @@ from .paged_attention import (  # noqa: F401
     KV_DTYPES, dequantize_kv, kv_bytes_per_token, paged_attention_reference,
     paged_decode_attention, paged_prefill_attention, quantize_kv,
 )
+from .registry import (  # noqa: F401
+    ModelRegistry, ModelVariant, apply_delta, model_salt,
+)
 from .replica import (  # noqa: F401
     NoHealthyReplicaError, Replica, ReplicaScheduler, build_replicas,
 )
 from .server import ServeServer, run_commandline  # noqa: F401
+from .tenancy import (  # noqa: F401
+    DeficitRoundRobin, TenantAccounting, TenantConfig, safe_tenant,
+)

@@ -37,6 +37,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from .tenancy import (TENANT_DEFAULT, DeficitRoundRobin, TenantConfig,
+                      request_cost, safe_tenant)
+
 #: QoS admission tiers (docs/serving.md control plane): ``latency`` is
 #: the SLO-bearing interactive class, ``throughput`` the best-effort
 #: batch class — first shed under brownout, bounded separately.
@@ -92,10 +95,26 @@ class Request:
                  top_p: float = 1.0,
                  n: int = 1,
                  seed: Optional[int] = None,
-                 qos: str = "latency"):
+                 qos: str = "latency",
+                 tenant: str = TENANT_DEFAULT,
+                 model: Optional[str] = None):
         from .sampling import validate_params
         (self.temperature, self.top_k, self.top_p, self.n,
          self.seed) = validate_params(temperature, top_k, top_p, n, seed)
+        # Multi-tenant identity + model variant (serve/tenancy.py,
+        # serve/registry.py): both share the tenant alphabet discipline
+        # — they become Prometheus labels and routing keys, so a hostile
+        # value must die HERE (the server maps ValueError to HTTP 400).
+        if safe_tenant(tenant) is None:
+            raise ValueError(
+                f"invalid tenant id {tenant!r} (ascii alnum/-_. , "
+                "1-64 chars)")
+        self.tenant = tenant
+        if model is not None and safe_tenant(model) is None:
+            raise ValueError(
+                f"invalid model name {model!r} (ascii alnum/-_. , "
+                "1-64 chars)")
+        self.model = model
         if qos not in QOS_TIERS:
             # The server maps this to HTTP 400 like every other
             # validation error — an unknown tier must never silently
@@ -261,7 +280,8 @@ class DynamicBatcher:
 
     def __init__(self, max_queue: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
-                 on_shed: Optional[Callable[[Request, str], None]] = None):
+                 on_shed: Optional[Callable[[Request, str], None]] = None,
+                 tenants: Optional[TenantConfig] = None):
         self.max_queue = max_queue if max_queue is not None else int(
             os.environ.get("HVD_SERVE_MAX_QUEUE", "256"))
         self.max_wait_s = (max_wait_ms if max_wait_ms is not None else float(
@@ -282,6 +302,13 @@ class DynamicBatcher:
         # caps each taken request's effective max_new_tokens.
         self.brownout_level = 0
         self.brownout_max_new = 0
+        # Per-tenant policy (serve/tenancy.py): quotas enforced at
+        # submit, weighted-DRR interleave applied at take time UNDER the
+        # QoS ordering.  Deficit state lives on _drr and persists across
+        # admission rounds.
+        self.tenants = tenants if tenants is not None \
+            else TenantConfig.from_env()
+        self._drr = DeficitRoundRobin(self.tenants)
         self._on_shed = on_shed
         self._queue: List[Request] = []
         self._lock = threading.Lock()
@@ -311,6 +338,25 @@ class DynamicBatcher:
                              if r.qos == request.qos) >= bound:
                 raise QueueFullError(
                     f"{request.qos} tier at capacity ({bound})")
+            # Per-tenant quotas (serve/tenancy.py): a queue-slot bound
+            # and a token-footprint quota, both over this tenant's
+            # currently-queued work — requeue_front bypasses them (the
+            # already-accepted-work contract above).
+            tq = self.tenants.max_queue
+            if tq and sum(1 for r in self._queue
+                          if r.tenant == request.tenant) >= tq:
+                raise QueueFullError(
+                    f"tenant {request.tenant!r} queue at capacity "
+                    f"({tq})")
+            tt = self.tenants.max_tokens
+            if tt:
+                held = sum(request_cost(r) for r in self._queue
+                           if r.tenant == request.tenant)
+                if held + request_cost(request) > tt:
+                    raise QueueFullError(
+                        f"tenant {request.tenant!r} token quota "
+                        f"exceeded ({held} held + "
+                        f"{request_cost(request)} > {tt})")
             self._queue.append(request)
             self._cond.notify_all()
 
@@ -422,6 +468,15 @@ class DynamicBatcher:
                             # (_order_key; stable, so deadline-less
                             # single-tier traffic keeps exact FIFO).
                             self._queue.sort(key=_order_key)
+                            if len({r.tenant for r in self._queue}) > 1:
+                                # Weighted-DRR tenant interleave UNDER
+                                # the class order (serve/tenancy.py):
+                                # reorders only within runs of equal
+                                # (requeued, tier) class; single-tenant
+                                # queues skip entirely, keeping the
+                                # legacy admission order byte-exact.
+                                self._queue[:] = self._drr.reorder(
+                                    self._queue)
                             taken = self._take(free_slots, budget, cost,
                                                hard_cap)
                             if taken:

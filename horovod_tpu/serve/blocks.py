@@ -42,14 +42,21 @@ class NoFreeBlocksError(Exception):
     (prefix-cached, unreferenced) block."""
 
 
-def chain_hashes(tokens: Sequence[int], block_tokens: int) -> List[int]:
+def chain_hashes(tokens: Sequence[int], block_tokens: int,
+                 salt: int = 0) -> List[int]:
     """Chained content hashes of the FULL blocks of ``tokens``.
 
     ``h_i`` covers tokens ``[0, (i+1)*block_tokens)`` — chaining makes the
     hash positional, so block content [5,6] at offset 0 and at offset 16
-    never collide.  Partial tail blocks get no hash (never shared)."""
+    never collide.  Partial tail blocks get no hash (never shared).
+
+    ``salt`` seeds the chain: the engine salts per (model, version)
+    (registry.model_salt) so prefixes never match across variants or
+    across a weight roll — equal tokens under DIFFERENT weights produce
+    different K/V, which sharing must never conflate.  Salt 0 is the
+    default model at version 0, keeping legacy hashes byte-exact."""
     out: List[int] = []
-    h = 0
+    h = salt
     for i in range(len(tokens) // block_tokens):
         h = hash((h, tuple(tokens[i * block_tokens:(i + 1) * block_tokens])))
         out.append(h)

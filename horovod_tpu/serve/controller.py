@@ -408,7 +408,12 @@ class FleetController:
         latency-tier p99 (bucket-count delta since the previous poll)."""
         replicas = self.scheduler.fleet()
         healthy = [r for r in replicas if r.state == "healthy"]
-        dead = [r for r in replicas if r.state == "dead"]
+        # A replica mid-roll (registry.roll drain->swap->revive) is
+        # transiently dead but NOT spare capacity: counting it would
+        # tempt decide() into a scale_up that _scale_up cannot honor
+        # (and reviving it early would serve a half-swapped engine).
+        dead = [r for r in replicas
+                if r.state == "dead" and not getattr(r, "rolling", False)]
         queued = 0
         active = 0
         headroom: Optional[int] = None
@@ -433,7 +438,8 @@ class FleetController:
     # -- actuation (never under self._lock) ----------------------------------
 
     def _scale_up(self, snap: FleetSnapshot) -> None:
-        dead = [r for r in self.scheduler.fleet() if r.state == "dead"]
+        dead = [r for r in self.scheduler.fleet()
+                if r.state == "dead" and not getattr(r, "rolling", False)]
         if dead:
             self.scheduler.mark_alive(dead[0].replica_id,
                                       reason="hvdctl: sustained pressure")

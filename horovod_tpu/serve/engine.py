@@ -78,6 +78,38 @@ def _next_pow2(n: int, floor: int = 1) -> int:
     return b
 
 
+_COMPILE_CACHE_ENABLED = False
+
+
+def maybe_enable_compile_cache() -> None:
+    """Zero cold-start, persistent half (docs/serving.md warmup):
+    point jax's compilation cache at ``HVD_SERVE_COMPILE_CACHE`` (a
+    directory) so a restarted server — or a controller-grown replica in
+    a fresh process — REUSES the previous process's XLA executables
+    instead of re-lowering every (bucket, batch) program.  Idempotent;
+    a failure is logged and serving proceeds uncached (the AOT warmup
+    still hides the compiles off the request path)."""
+    global _COMPILE_CACHE_ENABLED
+    path = os.environ.get("HVD_SERVE_COMPILE_CACHE", "")
+    if not path or _COMPILE_CACHE_ENABLED:
+        return
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Serve-bucket programs are small and compile fast; without
+        # these floors the cache would skip exactly the programs the
+        # warmup wants persisted.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _COMPILE_CACHE_ENABLED = True
+    except Exception as e:  # pragma: no cover - config-dependent
+        get_logger().warning(
+            "serve: could not enable the persistent compile cache at "
+            "%s: %s", path, e)
+
+
 # ---------------------------------------------------------------------------
 # Model adapters
 # ---------------------------------------------------------------------------
@@ -1094,8 +1126,20 @@ class InferenceEngine:
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 warmup: Optional[bool] = None):
+        maybe_enable_compile_cache()
         self.adapter = adapter
+        # Multi-model residency (serve/registry.py): named variants
+        # sharing this engine's slots and paged pool.  ``adapter`` stays
+        # the default variant's adapter (every legacy single-model path
+        # reads it); requests carrying ``model`` resolve through
+        # _adapter_for.  Versions feed the per-(model, version) prefix-
+        # hash salt so cached prefixes never cross a weight boundary.
+        self.default_model = "default"
+        self._adapters: Dict[str, ModelAdapter] = {
+            self.default_model: adapter}
+        self._model_versions: Dict[str, int] = {self.default_model: 0}
         self.max_batch = max_batch if max_batch is not None else int(
             os.environ.get("HVD_SERVE_MAX_BATCH", "8"))
         self.batcher = batcher or DynamicBatcher()
@@ -1105,7 +1149,8 @@ class InferenceEngine:
             # surface them in this engine's metrics ("expired" outcome
             # — and "shed" for brownout purges, which pass that reason).
             self.batcher._on_shed = \
-                lambda req, why: self.metrics.count_request(why)
+                lambda req, why: self.metrics.count_request(
+                    why, tenant=req.tenant)
         self.replica_id = replica_id
         # Brownout rung (serve/controller.py), set by the
         # FleetController and read lock-free in the loop (plain int,
@@ -1217,6 +1262,18 @@ class InferenceEngine:
         # timestamps are captured at the boundary, so deferral changes
         # nothing in the artifact.
         self._trace_emits: List = []
+        # Zero cold-start, AOT half (warmup(), docs/serving.md): replay
+        # the (pow2 count, pow2 len) prefill/decode bucket ladder at
+        # EVERY start() — construction AND mark_alive revival — so the
+        # first real request after a scale-up or a roll never pays a
+        # compile.  Off by default (HVD_SERVE_WARMUP): tests and
+        # single-shot tools should not pay the ladder.
+        self._warmup_enabled = (
+            warmup if warmup is not None
+            else os.environ.get("HVD_SERVE_WARMUP", "0")
+            not in ("0", "false"))
+        self.warmup_runs = 0
+        self.last_warmup_ms = 0.0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1246,14 +1303,116 @@ class InferenceEngine:
             # fall back to what the pool arrays actually hold.
             pool_bytes = _memplan.params_bytes(self._cache)
         self.pool_bytes = int(pool_bytes)
-        self.weight_bytes = _memplan.params_bytes(
-            getattr(self.adapter, "params", None))
+        # Weight bytes sum over the DISTINCT resident adapters (a
+        # LoRA-style variant shares most leaves with the base by
+        # reference, but params_bytes walks whole trees — the sum is a
+        # conservative upper bound, which is the right direction for a
+        # budget check).
+        distinct = {id(ad): ad for ad in self._adapters.values()}
+        self.weight_bytes = sum(
+            _memplan.params_bytes(getattr(ad, "params", None))
+            for ad in distinct.values())
         report = _memplan.check_pool_budget(
             f"serve:{self.replica_id}:kv-pool", self.pool_bytes,
             self.weight_bytes)
         self.kv_headroom_bytes = report.headroom_bytes
         if not report.ok():
             _memplan.publish_report(report)
+
+    # -- multi-model residency (serve/registry.py) ---------------------------
+
+    def _check_geometry(self, adapter) -> None:
+        """A co-resident variant shares this engine's slot table and
+        paged pool, so every shape the shared state bakes in must match
+        the default adapter's — checked loudly at add/swap time, not at
+        the first mismatched gather."""
+        base = self.adapter
+        if not all(hasattr(adapter, m) for m in
+                   ("init_paged_cache", "prefill_chunk", "decode_paged")):
+            raise ValueError(
+                f"{type(adapter).__name__} has no paged interface; "
+                f"multi-model residency is paged-only")
+        for attr in ("max_len", "block_tokens", "max_blocks_per_seq",
+                     "kv_token_cost"):
+            a, b = getattr(adapter, attr, None), getattr(base, attr, None)
+            if a is not None and b is not None and a != b:
+                raise ValueError(
+                    f"variant adapter {attr}={a} != resident {attr}={b}")
+        a_bpb = getattr(adapter, "paged_block_bytes", None)
+        b_bpb = getattr(base, "paged_block_bytes", None)
+        if callable(a_bpb) and callable(b_bpb) and a_bpb() != b_bpb():
+            raise ValueError(
+                f"variant paged_block_bytes {a_bpb()} != resident "
+                f"{b_bpb()} — the pool layout cannot serve both")
+        a_cfg, b_cfg = getattr(adapter, "cfg", None), getattr(base, "cfg",
+                                                             None)
+        if a_cfg is not None and b_cfg is not None:
+            for attr in ("num_layers", "num_heads", "d_model"):
+                if getattr(a_cfg, attr) != getattr(b_cfg, attr):
+                    raise ValueError(
+                        f"variant cfg.{attr}={getattr(a_cfg, attr)} != "
+                        f"resident {getattr(b_cfg, attr)}")
+        sample_capable = (hasattr(adapter, "decode_paged_sampled")
+                          and hasattr(adapter, "prefill_chunk_logits"))
+        if self._sample_capable and not sample_capable:
+            raise ValueError(
+                f"{type(adapter).__name__} lacks the sampled programs "
+                f"this engine advertises (decode_paged_sampled/"
+                f"prefill_chunk_logits)")
+
+    def add_model(self, name: str, adapter, version: int = 0) -> None:
+        """Make variant ``name`` resident: it shares the slot table and
+        the paged pool with the default model (requests partition by
+        model per iteration, _prefill_step/_decode_once_paged).
+
+        Paged-only BY DESIGN: the slot-mode decode program writes K/V at
+        position 0 of every INACTIVE row (masked reads make that
+        harmless single-model), so interleaving a second model's decode
+        would corrupt the other group's live caches.  The paged
+        programs address exclusively through block tables — an all-hole
+        row touches nothing."""
+        if self.kv_mode != "paged":
+            raise ValueError(
+                "multi-model residency requires kv_mode='paged' "
+                "(slot-mode decode clobbers inactive rows)")
+        if name == self.default_model or name in self._adapters:
+            raise ValueError(f"model {name!r} already resident; use "
+                             "swap_model to change its weights")
+        self._check_geometry(adapter)
+        with self._lock:
+            self._adapters[name] = adapter
+            self._model_versions[name] = int(version)
+        # Re-run the budget check: a second resident variant's weights
+        # count against the same HBM budget.
+        self._verify_pool_budget(self.blocks.num_blocks)
+
+    def swap_model(self, name: str, adapter, version: int) -> None:
+        """Install new weights for resident variant ``name`` (the
+        registry's roll path).  Only legal on a STOPPED engine — the
+        roll machinery drains this replica first (mark_dead), so no
+        iteration is mid-flight over the old adapter's programs; the
+        subsequent start() re-runs warmup over the new adapter."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                f"{self.replica_id}: swap_model requires a stopped "
+                f"engine (drain it first — registry.roll does)")
+        if name not in self._adapters:
+            raise KeyError(f"model {name!r} not resident")
+        self._check_geometry(adapter)
+        self._adapters[name] = adapter
+        self._model_versions[name] = int(version)
+        if name == self.default_model:
+            self.adapter = adapter
+        if self.kv_mode == "paged":
+            self._verify_pool_budget(self.blocks.num_blocks)
+
+    def _adapter_for(self, model: Optional[str]):
+        return self._adapters[model or self.default_model]
+
+    def _prefix_salt(self, model: Optional[str]) -> int:
+        from .registry import model_salt
+        name = model or self.default_model
+        return model_salt(name, self._model_versions.get(name, 0))
 
     # -- introspection -------------------------------------------------------
 
@@ -1292,6 +1451,112 @@ class InferenceEngine:
             stats["kv_headroom_bytes"] = self.kv_headroom_bytes
         return stats
 
+    # -- warmup (zero cold-start) --------------------------------------------
+
+    def _warmup_counts(self) -> List[int]:
+        """Every reachable batch-count bucket: pow2 ladder up to
+        ``max_batch``, plus ``max_batch`` itself when it is not a power
+        of two (its bucket ``_next_pow2(max_batch)`` is only hit by a
+        full admission)."""
+        counts: List[int] = []
+        n = 1
+        while n <= self.max_batch:
+            counts.append(n)
+            n *= 2
+        if counts[-1] != self.max_batch:
+            counts.append(self.max_batch)
+        return counts
+
+    def warmup(self) -> float:
+        """Replay every (count, len) prefill bucket plus one decode step
+        per resident adapter so the XLA programs this engine serves from
+        are compiled BEFORE mark_alive reports the replica healthy.
+        Only legal against an empty slot table (a busy engine skips: the
+        live cache must not see warmup writes); combined with the
+        persistent compile cache (HVD_SERVE_COMPILE_CACHE) a freshly
+        grown replica pays disk-cache lookups, not compiles.  Returns
+        wall-clock milliseconds spent (0.0 when skipped or failed —
+        warmup failure degrades to cold serving, never to a dead
+        replica)."""
+        with self._lock:
+            if any(s is not None for s in self._slots):
+                get_logger().warning(
+                    "%s: warmup skipped — slots busy", self.replica_id)
+                return 0.0
+        t0 = time.monotonic()
+        try:
+            if self.kv_mode == "paged":
+                self._warmup_paged()
+            else:
+                self._warmup_slot()
+        except Exception as exc:
+            get_logger().warning(
+                "%s: warmup failed (%s: %s); serving cold",
+                self.replica_id, type(exc).__name__, exc)
+            return 0.0
+        ms = (time.monotonic() - t0) * 1e3
+        self.warmup_runs += 1
+        self.last_warmup_ms = ms
+        self.metrics.observe_warmup(self.replica_id, ms)
+        get_logger().info("%s: warmup #%d done in %.1f ms",
+                          self.replica_id, self.warmup_runs, ms)
+        return ms
+
+    def _warmup_paged(self) -> None:
+        """Drive every resident adapter (id-deduped: variants sharing
+        one adapter object compile once) through the paged bucket
+        lattice.  Chunks are all-hole — empty block tables map every
+        K/V write onto the dropped sentinel row — so retained prefix
+        blocks and pool accounting are untouched; only the compile
+        caches change.  Decode warms at its single runtime shape:
+        tokens ``(max_batch,)`` and tables exactly ``(max_batch,
+        self._mb)`` (shapes are compile keys — a padded stand-in would
+        warm a program the loop never runs)."""
+        nb = self.blocks.capacity
+        distinct = {id(ad): ad for ad in self._adapters.values()}
+        for ad in distinct.values():
+            cap = min(self._chunk_budget or ad.max_len, ad.max_len)
+            lens: List[int] = []
+            c = prompt_bucket(1, cap=ad.max_len)
+            top = prompt_bucket(cap, cap=ad.max_len)
+            while True:
+                lens.append(c)
+                if c >= top:
+                    break
+                c = min(c * 2, top)
+            for n in self._warmup_counts():
+                for c in lens:
+                    self._cache, _ = ad.prefill_chunk(
+                        self._cache, [[0] * c for _ in range(n)],
+                        [0] * n, [[] for _ in range(n)])
+            tokens = np.zeros((self.max_batch,), np.int32)
+            positions = np.zeros((self.max_batch,), np.int32)
+            tables = np.full((self.max_batch, self._mb), nb, np.int32)
+            self._cache, _ = ad.decode_paged(
+                self._cache, tokens, positions, tables)
+
+    def _warmup_slot(self) -> None:
+        """Slot-mode ladder (single adapter — add_model refuses slot
+        engines).  Writes land in real cache rows, which is safe only
+        because the empty-slot guard in warmup() held: the first real
+        prefill into any slot overwrites position 0 wholesale."""
+        ad = self.adapter
+        lens: List[int] = []
+        c = prompt_bucket(1, cap=ad.max_len)
+        while True:
+            lens.append(c)
+            if c >= ad.max_len:
+                break
+            c = min(c * 2, ad.max_len)
+        for n in self._warmup_counts():
+            slots = list(range(n))
+            for c in lens:
+                self._cache, _ = ad.prefill(
+                    self._cache, [[0] * c for _ in range(n)], slots)
+        self._cache, _ = ad.decode(
+            self._cache, np.zeros((self.max_batch,), np.int32),
+            np.zeros((self.max_batch,), np.int32))
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "InferenceEngine":
@@ -1313,6 +1578,15 @@ class InferenceEngine:
         # the same object: the stop flag must clear or the new thread
         # exits before its first iteration.
         self._stop.clear()
+        # Warmup runs at EVERY start — construction and mark_alive
+        # revival alike (the revived-replica cold-start bug: warmup only
+        # at construction would make a controller-grown replica re-pay
+        # every bucket compile on its first real requests).  It runs
+        # BEFORE the loop thread spawns, so mark_alive's "healthy" means
+        # "warm": routing only rebalances onto this replica once its
+        # bucket programs are compiled.
+        if self._warmup_enabled:
+            self.warmup()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"hvd-serve-engine-{self.replica_id}")
@@ -1499,6 +1773,10 @@ class InferenceEngine:
             if ms > 0.0:
                 self.metrics.observe_stage(stage, ms)
                 self.metrics.observe_stage(f"{stage}|{r.qos}", ms)
+                # Per-tenant stage series (serve/tenancy.py; its own
+                # dict on the metrics side — a tenant label must never
+                # parse as a tier).
+                self.metrics.observe_tenant_stage(r.tenant, stage, ms)
         # End-to-end latency per tier (the stage partition's sum — the
         # windowed-p99 input of the controller's SLO check) + the
         # service-time EWMA behind the load-aware Retry-After hint.
@@ -1525,7 +1803,7 @@ class InferenceEngine:
                                 root=True)
             self._trace_emits.append(emit)
         r.complete()
-        self.metrics.count_request("ok")
+        self.metrics.count_request("ok", tenant=r.tenant)
 
     def _observe_admission(self, requests: Sequence[Request]) -> None:
         """Per-request admission boundary: credit the wait to queue (or
@@ -1573,14 +1851,26 @@ class InferenceEngine:
             r.fail(DeadlineExceededError(
                 f"{r.request_id} expired before prefill "
                 f"({time.monotonic() - r.submitted_at:.3f}s since submit)"))
-            self.metrics.count_request("expired")
+            self.metrics.count_request("expired", tenant=r.tenant)
             return True
+        # Unknown model variant: routing filters candidates on residency
+        # (replica.submit), so this fires only for direct engine submits
+        # or a variant that left the fleet between routing and admission
+        # — loudly either way, never silently served the default model.
+        if r.model is not None and r.model not in self._adapters:
+            r.fail(ValueError(
+                f"{r.request_id}: unknown model {r.model!r} on "
+                f"{self.replica_id} (resident: "
+                f"{sorted(self._adapters)})"))
+            self.metrics.count_request("error", tenant=r.tenant)
+            return True
+        ad = self._adapter_for(r.model)
         total = len(r.prompt) + r.max_new_tokens
-        if total > self.adapter.max_len:
+        if total > ad.max_len:
             r.fail(ValueError(
                 f"{r.request_id}: prompt+max_new_tokens {total} exceeds "
-                f"max_len {self.adapter.max_len}"))
-            self.metrics.count_request("error")
+                f"max_len {ad.max_len}"))
+            self.metrics.count_request("error", tenant=r.tenant)
             return True
         # Sampling / n>1 need the logits + sampled adapter programs and
         # the paged engine (fork tables are CoW block tables; the slot
@@ -1592,13 +1882,13 @@ class InferenceEngine:
                 f"an adapter with prefill_chunk_logits/"
                 f"decode_paged_sampled (kv_mode={self.kv_mode}, "
                 f"adapter {type(self.adapter).__name__})"))
-            self.metrics.count_request("error")
+            self.metrics.count_request("error", tenant=r.tenant)
             return True
         if r.n > self.max_batch:
             r.fail(ValueError(
                 f"{r.request_id}: n={r.n} exceeds the engine's "
                 f"max_batch {self.max_batch} decode slots"))
-            self.metrics.count_request("error")
+            self.metrics.count_request("error", tenant=r.tenant)
             return True
         # Same cost formula as admission's cost/hard_cap (incl.
         # kv_token_cost and the n>1 shared-prompt + n-tails shape) — a
@@ -1610,7 +1900,7 @@ class InferenceEngine:
                 f"{r.request_id}: needs "
                 f"{self._request_cost_blocks(r)} KV blocks but the "
                 f"pool holds {self.blocks.capacity}"))
-            self.metrics.count_request("error")
+            self.metrics.count_request("error", tenant=r.tenant)
             return True
         return False
 
@@ -1641,7 +1931,8 @@ class InferenceEngine:
                         f"{s.request.request_id} deadline expired "
                         f"mid-flight ({ntokens} token(s) "
                         f"generated)"))
-                    self.metrics.count_request("expired")
+                    self.metrics.count_request("expired",
+                                               tenant=s.request.tenant)
                     if s.request.trace is not None \
                             and _obs.TRACER is not None:
                         def emit(t=_obs.TRACER, r=s.request, now=now,
@@ -1856,8 +2147,12 @@ class InferenceEngine:
                     # Hash once; lookup reuses them (hashing is
                     # O(prompt) Python work on the decode-critical
                     # engine thread).
+                    # Salted per (model, version) — equal tokens under
+                    # different weights must never share K/V; salt 0 for
+                    # (default, v0) keeps legacy hashes byte-exact.
                     hashes = chain_hashes(r.prompt,
-                                          self.blocks.block_tokens)
+                                          self.blocks.block_tokens,
+                                          salt=self._prefix_salt(r.model))
                     cached_ids, cached_tokens = \
                         self.blocks.lookup_prefix(r.prompt, hashes=hashes)
                 need = self._blocks_for_tokens(
@@ -1948,12 +2243,28 @@ class InferenceEngine:
         use_logits = self._sample_capable and any(
             s.request.sampled or s.request.n > 1 for _, s, _ in sel)
         t0 = time.monotonic()
-        if use_logits:
-            self._cache, first = self.adapter.prefill_chunk_logits(
-                self._cache, chunks, starts, tables)
-        else:
-            self._cache, first = self.adapter.prefill_chunk(
-                self._cache, chunks, starts, tables)
+        # Multi-model partition: one chunk-prefill call per resident
+        # variant in this selection, threading the SHARED pool cache
+        # sequentially (donation-safe — each call consumes the previous
+        # one's output).  Single-model batches take exactly the legacy
+        # one-call path: one group holding every row.
+        by_model: Dict[Optional[str], List[int]] = {}
+        for j, (_, s, _) in enumerate(sel):
+            by_model.setdefault(s.request.model, []).append(j)
+        first: List = [None] * len(sel)
+        for model, idxs in by_model.items():
+            ad = self._adapter_for(model)
+            g_chunks = [chunks[j] for j in idxs]
+            g_starts = [starts[j] for j in idxs]
+            g_tables = [tables[j] for j in idxs]
+            if use_logits:
+                self._cache, g_first = ad.prefill_chunk_logits(
+                    self._cache, g_chunks, g_starts, g_tables)
+            else:
+                self._cache, g_first = ad.prefill_chunk(
+                    self._cache, g_chunks, g_starts, g_tables)
+            for j, tok in zip(idxs, g_first):
+                first[j] = tok
         now = time.monotonic()
         if _obs.TRACER is not None:
             # One prefill-chunk span per TRACED sequence in this batched
@@ -2052,7 +2363,7 @@ class InferenceEngine:
                     args={"reason": "kv-pool-exhausted"}, t=now)
             except Exception:
                 pass
-        self.metrics.count_request("preempted")
+        self.metrics.count_request("preempted", tenant=s.request.tenant)
         self.batcher.requeue_front([s.request])
         get_logger().warning(
             "%s: preempted %s (KV pool exhausted); requeued",
@@ -2138,40 +2449,56 @@ class InferenceEngine:
                 self._step_anchor = None
                 return 0
         nb = self.blocks.capacity if self.blocks is not None else 0
-        tokens = np.zeros((self.max_batch,), np.int32)
-        positions = np.zeros((self.max_batch,), np.int32)
-        tables = np.full((self.max_batch, self._mb), nb, np.int32)
-        sampled_rows = False
+        # Multi-model partition: one decode call per resident variant
+        # with decoding rows, threading the shared pool sequentially
+        # (the prefill partition's discipline).  Non-member rows in each
+        # call are inactive — zero tokens and ALL-HOLE tables, so their
+        # scatter writes drop and their masked reads are zero; a
+        # single-model batch is one group with every row, the legacy
+        # call bit-for-bit.
+        groups: Dict[Optional[str], List[Tuple[int, "_Seq"]]] = {}
         for i, s in active:
-            tokens[i] = s.generated[-1]
-            positions[i] = s.length  # next cache index = current length
-            tables[i, :len(s.table)] = s.table
-            sampled_rows = sampled_rows or s.request.sampled
+            groups.setdefault(s.request.model, []).append((i, s))
         t0 = time.monotonic()
-        if sampled_rows:
-            # Any sampled row switches the whole batch to the sampled
-            # program (greedy rows ride along with temperature 0 —
-            # their argmax is computed identically); per-row keys fold
-            # only that row's (seed, sample, position), so batched ==
-            # single given the same key holds by construction.
-            keys = _sampling.base_keys_array(
-                [None] * self.max_batch, self.max_batch)
-            temps = np.zeros((self.max_batch,), np.float32)
-            top_ks = np.zeros((self.max_batch,), np.int32)
-            top_ps = np.ones((self.max_batch,), np.float32)
-            for i, s in active:
-                r = s.request
-                if r.sampled:
-                    keys[i] = s.base_key
-                    temps[i] = r.temperature
-                    top_ks[i] = r.top_k or 0
-                    top_ps[i] = r.top_p
-            self._cache, nxt = self.adapter.decode_paged_sampled(
-                self._cache, tokens, positions, tables, keys, temps,
-                top_ks, top_ps)
-        else:
-            self._cache, nxt = self.adapter.decode_paged(
-                self._cache, tokens, positions, tables)
+        nxt_by_slot: Dict[int, int] = {}
+        for model, members in groups.items():
+            ad = self._adapter_for(model)
+            tokens = np.zeros((self.max_batch,), np.int32)
+            positions = np.zeros((self.max_batch,), np.int32)
+            tables = np.full((self.max_batch, self._mb), nb, np.int32)
+            sampled_rows = False
+            for i, s in members:
+                tokens[i] = s.generated[-1]
+                positions[i] = s.length  # next cache index = length
+                tables[i, :len(s.table)] = s.table
+                sampled_rows = sampled_rows or s.request.sampled
+            if sampled_rows:
+                # Any sampled row switches the whole call to the sampled
+                # program (greedy rows ride along with temperature 0 —
+                # their argmax is computed identically); per-row keys
+                # fold only that row's (seed, sample, position), so
+                # batched == single given the same key holds by
+                # construction.
+                keys = _sampling.base_keys_array(
+                    [None] * self.max_batch, self.max_batch)
+                temps = np.zeros((self.max_batch,), np.float32)
+                top_ks = np.zeros((self.max_batch,), np.int32)
+                top_ps = np.ones((self.max_batch,), np.float32)
+                for i, s in members:
+                    r = s.request
+                    if r.sampled:
+                        keys[i] = s.base_key
+                        temps[i] = r.temperature
+                        top_ks[i] = r.top_k or 0
+                        top_ps[i] = r.top_p
+                self._cache, nxt = ad.decode_paged_sampled(
+                    self._cache, tokens, positions, tables, keys, temps,
+                    top_ks, top_ps)
+            else:
+                self._cache, nxt = ad.decode_paged(
+                    self._cache, tokens, positions, tables)
+            for i, _ in members:
+                nxt_by_slot[i] = int(nxt[i])
         now = time.monotonic()
         # Inter-decode-step latency (see _decode_once): prefill chunks
         # between two decode steps land in this statistic by design.
@@ -2182,7 +2509,7 @@ class InferenceEngine:
             for i, s in active:
                 if self._slots[i] is not s:
                     continue  # drained/preempted concurrently
-                tok = int(nxt[i])
+                tok = nxt_by_slot[i]
                 s.generated.append(tok)
                 s.length += 1
                 self._defer_flow(s.request)
@@ -2426,7 +2753,8 @@ class InferenceEngine:
                         # fork family holds several slots.
                         failed.add(id(s.request))
                         s.request.fail(e)
-                        self.metrics.count_request("error")
+                        self.metrics.count_request(
+                            "error", tenant=s.request.tenant)
                     if self.blocks is not None:
                         self.blocks.free_table(s.table)
                     self._slots[i] = None
@@ -2461,8 +2789,19 @@ class InferenceEngine:
                 if paged:
                     self._admit_paged(block)
                     pre = self._prefill_step()
-                    dec = (self._spec_once()
-                           if self.spec_k > 0 and self.brownout_level < 3
+                    # Speculative decoding is single-model (the draft is
+                    # the DEFAULT adapter's): any non-default decoding
+                    # row falls back to the per-model greedy path —
+                    # bit-identical output, just no draft amortization
+                    # that iteration.
+                    spec_ok = self.spec_k > 0 and self.brownout_level < 3
+                    if spec_ok and len(self._adapters) > 1:
+                        with self._lock:
+                            spec_ok = all(
+                                s.request.model is None
+                                or s.request.model == self.default_model
+                                for s in self._slots if s is not None)
+                    dec = (self._spec_once() if spec_ok
                            else self._decode_once_paged())
                     if pre or dec:
                         self.metrics.observe_iteration(pre, dec)
@@ -2484,7 +2823,9 @@ class InferenceEngine:
                  top_k: Optional[int] = None,
                  top_p: float = 1.0,
                  n: int = 1,
-                 seed: Optional[int] = None) -> List[int]:
+                 seed: Optional[int] = None,
+                 model: Optional[str] = None,
+                 tenant: str = "default") -> List[int]:
         """Submit one request through the running loop and wait for it
         (n > 1: the returned list is sample 0; the full set is on the
         request's ``samples`` — use a hand-built Request for that)."""
@@ -2492,6 +2833,6 @@ class InferenceEngine:
             self.start()
         r = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    n=n, seed=seed)
+                    n=n, seed=seed, model=model, tenant=tenant)
         self.batcher.submit(r)
         return r.result(timeout=timeout_s)

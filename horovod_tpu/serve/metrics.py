@@ -29,7 +29,9 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+from .tenancy import TenantAccounting
 
 #: Histogram bucket upper bounds in milliseconds (Prometheus ``le`` label).
 #: Spans sub-ms MLP decodes through multi-second cold-compile prefills.
@@ -114,6 +116,24 @@ class ServeMetrics:
         self.requests: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
                                          "requeued": 0, "preempted": 0,
                                          "error": 0}
+        # Multi-tenant plane (serve/tenancy.py): per-tenant outcome
+        # counters and stage histograms, both keyed by the CAPPED label
+        # (TenantAccounting collapses past-the-cap tenants into
+        # "other").  tenant_stage_ms is its OWN dict — stage_ms keys
+        # carry the "stage|tier" convention, and a tenant label must
+        # never parse as a tier.
+        self._tenants = TenantAccounting()
+        self.tenant_requests: Dict[Tuple[str, str], int] = {}
+        self.tenant_stage_ms: Dict[Tuple[str, str], Histogram] = {}
+        # Live hot-swap progress per model (serve/registry.py roll):
+        # (replicas done, replicas total) of the in-flight/last roll.
+        self.swap_progress: Dict[str, Tuple[int, int]] = {}
+        # Zero-cold-start warmup (engine.warmup): wall ms of the last
+        # warmup and the number of warmups each replica ran — the
+        # regression surface for "mark_alive re-warms" (tests pin that
+        # runs increments on every engine (re)start).
+        self.warmup_ms: Dict[str, float] = {}
+        self.warmup_runs: Dict[str, int] = {}
         # Preemption-watcher health: transient KV errors the poller
         # survived (a dead watcher means preemptions go unnoticed
         # forever, so its error count must be observable).
@@ -176,9 +196,17 @@ class ServeMetrics:
             self.decode_tokens_total += decode_tokens
             self.iterations_total += 1
 
-    def count_request(self, outcome: str) -> None:
+    def count_request(self, outcome: str,
+                      tenant: Optional[str] = None) -> None:
+        # label() takes the accounting's own (leaf) lock BEFORE we take
+        # self._lock — never nested inside it, so no new ordering edge.
+        label = self._tenants.label(tenant) if tenant is not None else None
         with self._lock:
             self.requests[outcome] = self.requests.get(outcome, 0) + 1
+            if label is not None:
+                key = (label, outcome)
+                self.tenant_requests[key] = \
+                    self.tenant_requests.get(key, 0) + 1
 
     def count_tokens(self, n: int) -> None:
         """Tokens emitted outside the TTFT/decode-step observers (the
@@ -204,6 +232,48 @@ class ServeMetrics:
             if h is None:
                 h = self.stage_ms[stage] = Histogram()
             h.observe(ms)
+
+    def observe_tenant_stage(self, tenant: str, stage: str,
+                             ms: float) -> None:
+        """One completed request's time in ``stage`` attributed to its
+        tenant (cardinality-capped label) — engine._complete's
+        per-tenant emission next to the aggregate observe_stage."""
+        label = self._tenants.label(tenant)
+        with self._lock:
+            key = (label, stage)
+            h = self.tenant_stage_ms.get(key)
+            if h is None:
+                h = self.tenant_stage_ms[key] = Histogram()
+            h.observe(ms)
+
+    def set_swap_progress(self, model: str, done: int,
+                          total: int) -> None:
+        """Roll progress gauge (serve/registry.py): ``done`` of
+        ``total`` replicas serve the target version."""
+        with self._lock:
+            self.swap_progress[model] = (int(done), int(total))
+
+    def swap_event(self, model: str, replica: str, phase: str,
+                   version: int) -> None:
+        """One hot-swap phase transition → SWAP timeline instant (the
+        brownout_event discipline: read the timeline under the lock,
+        emit outside it, never let the trace path break the roll)."""
+        with self._lock:
+            tl = self._timeline
+        if tl is None:
+            return
+        try:
+            tl.swap_event(model, replica, phase, version)
+        except Exception:
+            pass  # the metrics path must never take down a roll
+
+    def observe_warmup(self, replica_id: str, ms: float) -> None:
+        """One engine warmup pass (engine.warmup): last duration gauge +
+        run counter per replica."""
+        with self._lock:
+            self.warmup_ms[replica_id] = float(ms)
+            self.warmup_runs[replica_id] = \
+                self.warmup_runs.get(replica_id, 0) + 1
 
     def observe_request_ms(self, tier: str, ms: float) -> None:
         """One COMPLETED request's end-to-end latency by QoS tier
@@ -307,6 +377,19 @@ class ServeMetrics:
                 out[rid] = stats
         return out
 
+    def _tenant_snapshot_locked(self) -> dict:
+        # Caller holds self._lock.  {tenant: {"requests": {outcome: n},
+        # "stage": {stage: hist dict}}} — the bench multitenant arm
+        # reads per-tenant goodput (ok counts) off this.
+        out: Dict[str, dict] = {}
+        for (label, outcome), n in self.tenant_requests.items():
+            out.setdefault(label, {"requests": {}, "stage": {}})
+            out[label]["requests"][outcome] = n
+        for (label, stage), h in self.tenant_stage_ms.items():
+            out.setdefault(label, {"requests": {}, "stage": {}})
+            out[label]["stage"][stage] = h.to_dict()
+        return out
+
     def snapshot(self) -> dict:
         depths = self._queue_depths()
         kv = self._kv_stats()
@@ -324,6 +407,11 @@ class ServeMetrics:
                 "decode_steps": self.decode_steps_total,
                 "prefills": self.prefills_total,
                 "requests": dict(self.requests),
+                "tenants": self._tenant_snapshot_locked(),
+                "swap": {m: {"done": d, "total": t}
+                         for m, (d, t) in self.swap_progress.items()},
+                "warmup": {"ms": dict(self.warmup_ms),
+                           "runs": dict(self.warmup_runs)},
                 "replica_events": dict(self.replica_events),
                 "brownout_level": self.brownout_level,
                 "ctl_events": dict(self.ctl_events),
@@ -410,6 +498,13 @@ class ServeMetrics:
                     labels = f'stage="{stage}"'
                 hist("hvd_serve_stage_ms", self.stage_ms[stage],
                      labels=labels)
+            # Per-tenant stage decomposition (serve/tenancy.py): same
+            # histogram family, tenant-labeled series (cardinality
+            # capped at the accounting layer).
+            for (label, stage) in sorted(self.tenant_stage_ms):
+                hist("hvd_serve_stage_ms",
+                     self.tenant_stage_ms[(label, stage)],
+                     labels=f'stage="{stage}",tenant="{label}"')
             lines.append("# HELP hvd_serve_request_ms end-to-end "
                          "request latency by QoS tier, ms")
             lines.append("# TYPE hvd_serve_request_ms histogram")
@@ -425,6 +520,33 @@ class ServeMetrics:
             for outcome, n in sorted(self.requests.items()):
                 lines.append(
                     f'hvd_serve_requests_total{{outcome="{outcome}"}} {n}')
+            lines.append("# TYPE hvd_serve_tenant_requests_total counter")
+            for (label, outcome), n in sorted(
+                    self.tenant_requests.items()):
+                lines.append(
+                    f'hvd_serve_tenant_requests_total{{tenant="{label}",'
+                    f'outcome="{outcome}"}} {n}')
+            # Hot-swap roll progress (serve/registry.py): fraction of
+            # replicas serving the target version, per model.
+            lines.append("# TYPE hvd_serve_swap_progress gauge")
+            for model, (done, total) in sorted(
+                    self.swap_progress.items()):
+                frac = done / total if total else 0.0
+                lines.append(
+                    f'hvd_serve_swap_progress{{model="{model}"}} '
+                    f'{frac:g}')
+            # Warmup plane (engine.warmup): last pass duration + run
+            # count per replica — runs increments on EVERY engine
+            # (re)start, the mark_alive-rewarm regression surface.
+            lines.append("# TYPE hvd_serve_warmup_ms gauge")
+            for rid, ms in sorted(self.warmup_ms.items()):
+                lines.append(
+                    f'hvd_serve_warmup_ms{{replica="{rid}"}} {ms:g}')
+            lines.append("# TYPE hvd_serve_warmup_runs_total counter")
+            for rid, n in sorted(self.warmup_runs.items()):
+                lines.append(
+                    f'hvd_serve_warmup_runs_total{{replica="{rid}"}} '
+                    f'{n}')
             lines.append(
                 "# TYPE hvd_serve_preempt_poll_errors_total counter")
             lines.append(f"hvd_serve_preempt_poll_errors_total "

@@ -63,6 +63,10 @@ class Replica:
         self.process_set = process_set
         self.engine = engine
         self.state = "healthy"  # healthy | dead
+        # True only while registry.roll() is walking THIS replica through
+        # drain -> swap -> revive; the FleetController must not treat the
+        # transient dead state as scale-up capacity (controller.py).
+        self.rolling = False
 
     @property
     def ranks(self) -> List[int]:
@@ -82,7 +86,10 @@ class Replica:
                "queued": self.engine.batcher.depth(),
                "kv_mode": self.engine.kv_mode,
                "attn_impl": self.engine.attn_impl,
-               "kv_dtype": self.engine.kv_dtype}
+               "kv_dtype": self.engine.kv_dtype,
+               "rolling": self.rolling,
+               "models": {name: self.engine._model_versions.get(name, 0)
+                          for name in sorted(self.engine._adapters)}}
         kv = self.engine.kv_stats()
         if kv is not None:
             out["kv_blocks"] = {k: kv[k] for k in
@@ -174,9 +181,19 @@ class ReplicaScheduler:
                 request.trace = _obs.TRACER.new_context()
                 request._emit_root = True
         candidates = sorted(self._healthy(), key=lambda r: r.load())
+        if request.model is not None:
+            # Variant routing (hvdtenant): only replicas RESIDENT for the
+            # requested model are candidates.  An unknown-everywhere model
+            # is the caller's error (the server 400s it before this), but
+            # a model known to SOME replicas while all of them are dead
+            # is a fleet-health condition -> NoHealthyReplicaError / 503.
+            candidates = [r for r in candidates
+                          if request.model in r.engine._adapters]
         if not candidates:
-            self.metrics.count_request("error")
-            raise NoHealthyReplicaError("no healthy replicas")
+            self.metrics.count_request("error", tenant=request.tenant)
+            raise NoHealthyReplicaError(
+                "no healthy replicas" if request.model is None else
+                f"no healthy replica holds model {request.model!r}")
         last_exc: Optional[Exception] = None
         for replica in candidates:
             try:
@@ -184,7 +201,7 @@ class ReplicaScheduler:
                 return replica
             except QueueFullError as e:
                 last_exc = e
-        self.metrics.count_request("shed")
+        self.metrics.count_request("shed", tenant=request.tenant)
         raise last_exc  # every healthy queue is full: explicit shed
 
     def start(self) -> "ReplicaScheduler":
@@ -267,18 +284,32 @@ class ReplicaScheduler:
         # to the FRONT of the survivors' queues past the capacity bound
         # (requeue_front's contract), dealt round-robin starting at the
         # least-loaded survivor; one batched call per survivor keeps each
-        # chunk's relative order.
+        # chunk's relative order.  Variant-pinned orphans (request.model
+        # set) only deal onto survivors RESIDENT for that model — during
+        # a registry.roll the drained replica's work for the rolling
+        # variant lands exactly on the replicas still serving it.
         survivors = sorted(self._healthy(), key=lambda r: r.load())
         if not survivors:
             for req in orphans:
-                self.metrics.count_request("error")
+                self.metrics.count_request("error", tenant=req.tenant)
                 req.fail(NoHealthyReplicaError(
                     f"replica {replica_id} lost with no survivors"))
             return
         chunks = {s.replica_id: [] for s in survivors}
-        for i, req in enumerate(orphans):
-            self.metrics.count_request("requeued")
-            chunks[survivors[i % len(survivors)].replica_id].append(req)
+        rr: Dict[Optional[str], int] = {}  # per-model deal cursor
+        for req in orphans:
+            eligible = survivors if req.model is None else [
+                s for s in survivors
+                if req.model in s.engine._adapters]
+            if not eligible:
+                self.metrics.count_request("error", tenant=req.tenant)
+                req.fail(NoHealthyReplicaError(
+                    f"no surviving replica holds model {req.model!r}"))
+                continue
+            i = rr.get(req.model, 0)
+            rr[req.model] = i + 1
+            self.metrics.count_request("requeued", tenant=req.tenant)
+            chunks[eligible[i % len(eligible)].replica_id].append(req)
         for s in survivors:
             s.engine.batcher.requeue_front(chunks[s.replica_id])
         get_logger().warning("serve: requeued %d request(s) from %s",

@@ -239,6 +239,29 @@ class _ServeHandler(BaseHTTPRequestHandler):
             qos = payload.get("qos")
             if qos is None:
                 qos = self.headers.get("X-QoS-Tier") or "latency"
+            # Multi-tenancy ingress (docs/serving.md): the tenant rides
+            # the ``tenant`` payload field or the X-Tenant-Id header
+            # (body wins, like timeout/qos); Request validates the id
+            # alphabet (safe_tenant -> ValueError -> 400).  ``model``
+            # selects a registry variant; an unknown-everywhere model is
+            # the caller's error -> 400 here, BEFORE submit (a model
+            # known somewhere but with all its holders dead is a 503
+            # from routing instead).
+            tenant = payload.get("tenant")
+            if tenant is None:
+                tenant = self.headers.get("X-Tenant-Id") or "default"
+            model = payload.get("model")
+            if model is not None:
+                model = str(model)
+                registry = getattr(self.server, "registry", None)
+                if registry is not None:
+                    known = registry.has(model)
+                else:
+                    known = any(
+                        model in getattr(r.engine, "_adapters", {})
+                        for r in self.server.scheduler.fleet())
+                if not known:
+                    raise ValueError(f"unknown model {model!r}")
             request = Request(
                 prompt,
                 max_new_tokens=int(payload.get("max_new_tokens", 16)),
@@ -254,7 +277,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 top_p=payload.get("top_p", 1.0),
                 n=payload.get("n", 1),
                 seed=payload.get("seed"),
-                qos=str(qos).strip().lower())
+                qos=str(qos).strip().lower(),
+                tenant=str(tenant),
+                model=model)
         except (KeyError, TypeError, ValueError) as e:
             self._shed_log("bad_request", None, e)
             self._reply_json(400, {"error": str(e)})
@@ -304,7 +329,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # reproduces a sampled answer bit-for-bit.
             "seed": request.seed,
             "qos": request.qos,
+            "tenant": request.tenant,
         }
+        if request.model is not None:
+            body["model"] = request.model
         if request.n > 1:
             body["n"] = request.n
             body["completions"] = request.samples
@@ -328,9 +356,13 @@ class ServeServer:
     def __init__(self, scheduler: ReplicaScheduler,
                  metrics: Optional[ServeMetrics] = None,
                  request_timeout_s: Optional[float] = None,
-                 controller=None):
+                 controller=None, registry=None):
         self.scheduler = scheduler
         self.metrics = metrics or scheduler.metrics
+        # Optional hvdtenant ModelRegistry (serve/registry.py): the
+        # /generate unknown-model gate asks it first; without one the
+        # handler falls back to scanning the fleet's resident adapters.
+        self.registry = registry
         # Optional hvdctl FleetController (serve/controller.py): owned
         # here so start/stop bracket the fleet's lifecycle — the
         # controller must stop actuating BEFORE the scheduler drains.
@@ -353,6 +385,7 @@ class ServeServer:
         self.httpd.daemon_threads = True
         self.httpd.scheduler = self.scheduler
         self.httpd.metrics = self.metrics
+        self.httpd.registry = self.registry
         self.httpd.request_timeout_s = self.request_timeout_s
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="hvd-serve-http")

@@ -66,6 +66,13 @@ FAULTLINE = "FAULTLINE"
 # the FAULTLINE instants that caused it.
 BROWNOUT = "BROWNOUT"
 
+# Live weight hot-swap transitions (serve/registry.py roll): every
+# per-replica phase of a rollout — drain, swap, alive, abort — is an
+# instant event under SWAP/<model>, so a trace shows the replica-by-
+# replica walk of a roll next to the replica death/revival events it
+# rides on, and exactly where an aborted roll stopped.
+SWAP = "SWAP"
+
 # Lock-witness findings (analysis/witness.py, HVD_SANITIZE=1): every
 # observed lock-order inversion / naked wait is an instant event under
 # WITNESS/<rule>, so a sanitized run's trace shows the near-deadlock at
@@ -312,6 +319,19 @@ class Timeline:
                    "s": "p", "ts": self._ts_us(), "pid": self.rank,
                    "tid": "hvdctl",
                    "args": {"level": int(level), "rung": rung}})
+
+    def swap_event(self, model: str, replica: str, phase: str,
+                   version: int):
+        """One hot-swap phase transition (serve/registry.py roll):
+        process-scoped instant event carrying the replica being walked,
+        the phase (``drain``/``swap``/``alive``/``abort``), and the
+        target version — the trace-side record of a live rollout's
+        replica-by-replica progress."""
+        self._put({"name": f"{SWAP}/{model}", "ph": "i", "s": "p",
+                   "ts": self._ts_us(), "pid": self.rank,
+                   "tid": "hvdswap",
+                   "args": {"replica": replica, "phase": phase,
+                            "version": int(version)}})
 
     def witness_event(self, rule: str, site_path: str, site_line: int,
                       thread_name: str):

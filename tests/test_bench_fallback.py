@@ -62,6 +62,10 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_CTL_BROWNOUT_MAX_NEW",
                 "HVD_SERVE_QOS_LAT_QUEUE", "HVD_SERVE_QOS_TPT_QUEUE",
                 "HVD_SERVE_RETRY_AFTER_CAP_S",
+                "HVD_SERVE_TENANT_WEIGHTS", "HVD_SERVE_TENANT_QUEUE",
+                "HVD_SERVE_TENANT_TOKENS", "HVD_SERVE_TENANT_QUANTUM",
+                "HVD_SERVE_TENANT_MAX_LABELS",
+                "HVD_SERVE_COMPILE_CACHE", "HVD_SERVE_WARMUP",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
@@ -307,6 +311,29 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert auto["scale_events"]["scale_up"] >= 1
         assert auto["scale_events"]["scale_down"] >= 1
         assert auto["brownout_seconds"] >= 0.0
+        # ISSUE 15: the multitenant arm — two variants on a shared
+        # fleet under weighted fair scheduling, a mid-traffic rolling
+        # hot-swap with zero failed requests and post-roll exactness,
+        # and the warmed cold-start probe.  fair_share_ratio values are
+        # recorded for the trend (tiny smoke storms are too short to
+        # gate on); the exactness/zero-failure booleans are hard.
+        mt = last["multitenant"]
+        for key in ("replicas", "tenants", "fair_share_ratio",
+                    "swap_zero_failures", "swap_progress",
+                    "post_roll_exact", "cold_start_ms", "warmup_runs",
+                    "first_request_ms", "tenant_requests"):
+            assert key in mt, f"multitenant.{key} missing: {mt}"
+        assert mt["swap_zero_failures"] is True
+        assert mt["post_roll_exact"] is True
+        assert set(mt["fair_share_ratio"]) == {"gold", "silver",
+                                               "bronze"}
+        prog = mt["swap_progress"]["tuned"]
+        assert prog["done"] == prog["total"] >= 1
+        assert mt["cold_start_ms"] > 0     # revived replica re-warmed
+        assert mt["warmup_runs"] >= 2      # start + the revival re-run
+        assert mt["first_request_ms"] > 0
+        for t in ("gold", "silver", "bronze"):
+            assert mt["tenant_requests"][t]["ok"] >= 1
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -48,9 +48,12 @@ def test_lint_covers_the_whole_tree():
     # HVD010 rule audits — it must stay inside the gate's walk.
     # controller.py (ISSUE 13) holds the fleet control plane — the
     # autoscale/brownout decision loop must stay under the same lint.
+    # tenancy.py / registry.py (ISSUE 15) carry the fairness scheduler
+    # and the hot-swap walk — same deal.
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
                 "server.py", "metrics.py", "paged_attention.py",
-                "sampling.py", "controller.py"):
+                "sampling.py", "controller.py", "tenancy.py",
+                "registry.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under

@@ -50,6 +50,8 @@ def test_mem_walk_covers_the_donating_tree():
     for mod in (os.path.join("serve", "engine.py"),
                 os.path.join("serve", "sampling.py"),
                 os.path.join("serve", "controller.py"),
+                os.path.join("serve", "tenancy.py"),
+                os.path.join("serve", "registry.py"),
                 os.path.join("parallel", "__init__.py"),
                 os.path.join("analysis", "memplan.py")):
         assert any(f.endswith(mod) for f in files), f"{mod} not analyzed"

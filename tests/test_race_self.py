@@ -78,6 +78,12 @@ def test_race_walk_covers_the_threaded_tree():
     # thread — the walker must see it for the registry check below.
     assert any(f.endswith(os.path.join("serve", "controller.py"))
                for f in files), "serve/controller.py not analyzed"
+    # The registry's roll walk (ISSUE 15) drains replicas while holding
+    # its own lock; tenancy's DRR is called under the batcher's.
+    assert any(f.endswith(os.path.join("serve", "registry.py"))
+               for f in files), "serve/registry.py not analyzed"
+    assert any(f.endswith(os.path.join("serve", "tenancy.py"))
+               for f in files), "serve/tenancy.py not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")
@@ -92,7 +98,8 @@ def test_race_walk_covers_the_threaded_tree():
                   "InferenceEngine._lock", "ReplicaScheduler._lock",
                   "BlockManager._lock", "ElasticDriver._lock",
                   "Negotiator._buf_lock", "Negotiator._flush_lock",
-                  "Tracer._lock", "FleetController._lock"):
+                  "Tracer._lock", "FleetController._lock",
+                  "ModelRegistry._lock"):
         assert label in analyzer.lock_sites, \
             f"{label} missing from the witness registry"
     # Condition-wraps-lock aliasing: the batcher's _cond must NOT appear

@@ -1,0 +1,625 @@
+"""hvdtenant tests (docs/serving.md multi-tenancy / hot-swap / warmup):
+
+* tenancy primitives — tenant alphabet, weight parsing, weighted
+  deficit-round-robin fairness UNDER the QoS class ordering, per-tenant
+  queue/token quotas, metrics cardinality cap;
+* model registry — variant registration/placement, request routing to
+  resident replicas, unknown-model rejection, slot-mode refusal,
+  geometry checks, adapter deltas;
+* live hot-swap — replica-by-replica roll with zero failed requests and
+  post-roll bit-exactness, faultline ``swap-abort`` leaving a resumable
+  half-rolled fleet that serves BOTH versions;
+* zero cold-start — AOT bucket warmup at every engine start (the
+  mark_alive-revival regression pin), busy-engine skip, persistent
+  compile-cache bootstrap;
+* server ingress — tenant/model payload + header precedence, 400s.
+"""
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu.faultline as fl
+from horovod_tpu.faultline.plan import FaultInjected
+from horovod_tpu.models import create_mlp
+from horovod_tpu.models.transformer import (Transformer, TransformerConfig,
+                                            stack_block_params,
+                                            unstack_block_params)
+from horovod_tpu.serve import (DeficitRoundRobin, DynamicBatcher,
+                               InferenceEngine, MLPAdapter, ModelRegistry,
+                               QueueFullError, Replica, ReplicaScheduler,
+                               Request, ServeMetrics, ServeServer,
+                               TenantAccounting, TenantConfig,
+                               TransformerAdapter, apply_delta, model_salt,
+                               safe_tenant)
+from horovod_tpu.serve.blocks import chain_hashes
+from horovod_tpu.serve.tenancy import parse_weights, request_cost
+
+VOCAB = 31
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fl.uninstall()
+    yield
+    fl.uninstall()
+
+
+def _mlp_adapter(seed=3, vocab=VOCAB, max_len=64):
+    mlp = create_mlp(features=(16, vocab))
+    params = mlp.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, vocab)))["params"]
+    return MLPAdapter(mlp, params, vocab_size=vocab, max_len=max_len)
+
+
+def _mlp_chain(adapter, prompt, n):
+    seq = []
+    tok = prompt[-1]
+    for _ in range(n):
+        tok = int(adapter._apply(np.asarray([tok], np.int32))[0])
+        seq.append(tok)
+    return seq
+
+
+def _engine(adapter=None, replica_id="replica-t", warmup=False, **kw):
+    return InferenceEngine(adapter or _mlp_adapter(),
+                           batcher=DynamicBatcher(),
+                           metrics=ServeMetrics(), max_batch=4,
+                           kv_mode="paged", replica_id=replica_id,
+                           warmup=warmup, **kw)
+
+
+def _fleet(n=2, warmup=False, tenants=None, metrics=None):
+    metrics = metrics or ServeMetrics()
+    replicas = []
+    for i in range(n):
+        eng = InferenceEngine(
+            _mlp_adapter(3),
+            batcher=DynamicBatcher(tenants=tenants),
+            metrics=metrics, max_batch=4, kv_mode="paged",
+            replica_id=f"replica-{i}", warmup=warmup)
+        replicas.append(Replica(f"replica-{i}", None, eng))
+    return ReplicaScheduler(replicas, metrics=metrics)
+
+
+# -- tenancy primitives ------------------------------------------------------
+
+def test_safe_tenant_alphabet():
+    assert safe_tenant("acme-1.prod_x") == "acme-1.prod_x"
+    assert safe_tenant("a" * 64) == "a" * 64
+    for bad in ("", "a" * 65, "evil\r\nheader", "sp ace", 'q"uote',
+                "unié", None, 7):
+        assert safe_tenant(bad) is None
+
+
+def test_parse_weights_spec():
+    assert parse_weights("acme:3,beta:1.5, solo ,") == {
+        "acme": 3.0, "beta": 1.5, "solo": 1.0}
+    assert parse_weights("") == {}
+    with pytest.raises(ValueError):
+        parse_weights("bad name:2")
+    with pytest.raises(ValueError):
+        parse_weights("acme:0")
+
+
+def test_tenant_config_from_env(monkeypatch):
+    monkeypatch.setenv("HVD_SERVE_TENANT_WEIGHTS", "gold:3,bronze:1")
+    monkeypatch.setenv("HVD_SERVE_TENANT_QUEUE", "5")
+    monkeypatch.setenv("HVD_SERVE_TENANT_TOKENS", "200")
+    monkeypatch.setenv("HVD_SERVE_TENANT_QUANTUM", "16")
+    cfg = TenantConfig.from_env()
+    assert cfg.weights == {"gold": 3.0, "bronze": 1.0}
+    assert (cfg.max_queue, cfg.max_tokens, cfg.quantum) == (5, 200, 16)
+    assert cfg.weight("gold") == 3.0
+    assert cfg.weight("unlisted") == 1.0
+
+
+def test_request_rejects_bad_tenant_and_model():
+    with pytest.raises(ValueError):
+        Request([1], tenant="evil\r\nheader")
+    with pytest.raises(ValueError):
+        Request([1], model="bad model!")
+    r = Request([1, 2], max_new_tokens=6, tenant="acme", model="tuned")
+    assert (r.tenant, r.model) == ("acme", "tuned")
+    assert request_cost(r) == 8
+
+
+def test_drr_single_tenant_keeps_legacy_order():
+    drr = DeficitRoundRobin(TenantConfig(quantum=4))
+    reqs = [Request([i + 1], max_new_tokens=4) for i in range(5)]
+    assert drr.reorder(list(reqs)) == reqs
+
+
+def test_drr_weighted_interleave_matches_weights():
+    cfg = TenantConfig(weights={"gold": 3.0, "silver": 2.0, "bronze": 1.0},
+                       quantum=8)
+    drr = DeficitRoundRobin(cfg)
+    reqs = []
+    for _ in range(8):
+        for t in ("bronze", "silver", "gold"):  # worst arrival for gold
+            reqs.append(Request([1, 2, 3, 4, 5, 6], max_new_tokens=8,
+                                tenant=t))
+    out = drr.reorder(list(reqs))
+    assert sorted(r.request_id for r in out) == \
+        sorted(r.request_id for r in reqs)
+    # Equal-cost requests (cost 14): over the first 12 admitted, shares
+    # must track 3:2:1 within one quantum round's granularity.
+    head = [r.tenant for r in out[:12]]
+    assert head.count("gold") >= 5
+    assert head.count("silver") >= 3
+    assert head.count("bronze") <= 3
+    # Each tenant's own order is preserved (stable within tenant).
+    for t in ("gold", "silver", "bronze"):
+        mine = [r.request_id for r in out if r.tenant == t]
+        theirs = [r.request_id for r in reqs if r.tenant == t]
+        assert mine == theirs
+
+
+def test_drr_never_reorders_across_priority_classes():
+    cfg = TenantConfig(weights={"a": 1.0, "b": 100.0}, quantum=64)
+    drr = DeficitRoundRobin(cfg)
+    requeued = Request([1], max_new_tokens=2, tenant="b")
+    requeued.requeues = 1
+    lat_a = Request([2], max_new_tokens=2, tenant="a", qos="latency")
+    lat_b = Request([3], max_new_tokens=2, tenant="b", qos="latency")
+    tpt_b = Request([4], max_new_tokens=2, tenant="b", qos="throughput")
+    queue = [requeued, lat_a, lat_b, tpt_b]  # already _order_key-sorted
+    out = drr.reorder(list(queue))
+    assert out[0] is requeued                    # requeued class first
+    assert out[3] is tpt_b                       # throughput class last
+    assert {out[1], out[2]} == {lat_a, lat_b}    # only WITHIN the run
+
+
+def test_tenant_queue_bound_sheds():
+    b = DynamicBatcher(max_queue=100,
+                       tenants=TenantConfig(max_queue=2))
+    b.submit(Request([1], tenant="acme"))
+    b.submit(Request([2], tenant="acme"))
+    with pytest.raises(QueueFullError):
+        b.submit(Request([3], tenant="acme"))
+    b.submit(Request([4], tenant="beta"))  # other tenants unaffected
+
+
+def test_tenant_token_quota_sheds():
+    b = DynamicBatcher(max_queue=100,
+                       tenants=TenantConfig(max_tokens=20))
+    b.submit(Request([1, 2, 3], max_new_tokens=7, tenant="acme"))  # 10
+    b.submit(Request([1, 2, 3], max_new_tokens=7, tenant="acme"))  # 20
+    with pytest.raises(QueueFullError):
+        b.submit(Request([1], max_new_tokens=1, tenant="acme"))
+    b.submit(Request([1, 2, 3], max_new_tokens=7, tenant="beta"))
+
+
+def test_batcher_admission_interleaves_tenants():
+    """Through the real admission path: a bursty tenant submitted FIRST
+    cannot monopolize the admitted prefix."""
+    cfg = TenantConfig(weights={"burst": 1.0, "tiny": 1.0}, quantum=8)
+    b = DynamicBatcher(max_queue=100, max_wait_ms=0, tenants=cfg)
+    for i in range(6):
+        b.submit(Request([1, 2, 3, 4], max_new_tokens=4, tenant="burst"))
+    for i in range(2):
+        b.submit(Request([1, 2, 3, 4], max_new_tokens=4, tenant="tiny"))
+    taken = b.get_admission(4)
+    tenants = [r.tenant for r in taken]
+    assert "tiny" in tenants[:2]  # FIFO alone would admit burst x4
+
+
+def test_tenant_accounting_cardinality_cap():
+    acc = TenantAccounting(max_labels=2)
+    assert acc.label("a") == "a"
+    assert acc.label("b") == "b"
+    assert acc.label("c") == TenantAccounting.OVERFLOW
+    assert acc.label("a") == "a"  # registered labels stay stable
+    assert acc.label(None) == TenantAccounting.OVERFLOW
+
+
+def test_metrics_tenant_series_and_snapshot():
+    m = ServeMetrics()
+    m.count_request("ok", tenant="acme")
+    m.count_request("shed", tenant="acme")
+    m.count_request("ok", tenant="beta")
+    m.observe_tenant_stage("acme", "decode", 12.5)
+    m.set_swap_progress("tuned", 1, 4)
+    m.observe_warmup("replica-0", 42.0)
+    text = m.render()
+    assert 'hvd_serve_tenant_requests_total{tenant="acme",outcome="ok"} 1' \
+        in text
+    assert 'tenant="acme"' in text and 'tenant="beta"' in text
+    assert 'hvd_serve_swap_progress{model="tuned"} 0.25' in text
+    assert 'hvd_serve_warmup_ms{replica="replica-0"}' in text
+    assert 'hvd_serve_warmup_runs_total{replica="replica-0"} 1' in text
+    snap = m.snapshot()
+    assert snap["tenants"]["acme"]["requests"] == {"ok": 1, "shed": 1}
+    assert snap["swap"] == {"tuned": {"done": 1, "total": 4}}
+    assert snap["warmup"]["runs"] == {"replica-0": 1}
+
+
+# -- model registry ----------------------------------------------------------
+
+def test_model_salt_and_prefix_hash_salting():
+    assert model_salt("default", 0) == 0          # legacy byte-exact
+    assert model_salt("default", 1) != 0          # roll invalidates
+    assert model_salt("tuned", 0) != model_salt("tuned", 1)
+    toks = list(range(32))
+    base = chain_hashes(toks, 16)
+    assert chain_hashes(toks, 16, salt=0) == base
+    assert chain_hashes(toks, 16, salt=model_salt("tuned", 0)) != base
+
+
+def test_apply_delta_full_lowrank_and_shape_check():
+    base = {"blk": {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}}
+    out = apply_delta(base, {"blk.b": np.full((4,), 2.0)})
+    assert np.allclose(out["blk"]["b"], 2.0)
+    assert out["blk"]["w"] is base["blk"]["w"]    # untouched leaf shared
+    a = np.ones((4, 2), np.float32)
+    b2 = np.ones((2, 4), np.float32)
+    out2 = apply_delta(base, {"blk.w": {"a": a, "b": b2}}, alpha=0.5)
+    assert np.allclose(out2["blk"]["w"], 1.0 + 0.5 * 2.0)
+    with pytest.raises(ValueError):
+        apply_delta(base, {"blk.b": np.zeros((5,))})
+
+
+def test_registry_register_routes_and_introspects():
+    sched = _fleet(2)
+    reg = ModelRegistry(sched)
+    reg.adopt("default")
+    alt = _mlp_adapter(7)
+    reg.register("alt", adapter=alt, replica_ids=["replica-1"])
+    assert reg.has("alt") and not reg.has("nope")
+    assert reg.replicas_for("alt") == ["replica-1"]
+    sched.start()
+    try:
+        r = Request([1, 2, 3], max_new_tokens=4, model="alt")
+        rep = sched.submit(r)
+        assert rep.replica_id == "replica-1"
+        assert r.result(timeout=30) == _mlp_chain(alt, [1, 2, 3], 4)
+        health = sched.healthz()["replicas"]
+        models = {h["id"]: h["models"] for h in health}
+        assert models["replica-0"] == {"default": 0}
+        assert models["replica-1"] == {"alt": 0, "default": 0}
+        with pytest.raises(ValueError):
+            reg.register("alt", adapter=_mlp_adapter(9))  # dup -> roll()
+        with pytest.raises(ValueError):
+            reg.register("bad name!", adapter=alt)
+    finally:
+        sched.stop()
+
+
+def test_engine_fails_unknown_model_request():
+    eng = _engine().start()
+    try:
+        r = Request([1, 2], max_new_tokens=2, model="ghost")
+        eng.batcher.submit(r)
+        with pytest.raises(ValueError, match="ghost"):
+            r.result(timeout=30)
+        assert eng.metrics.snapshot()["requests"]["error"] == 1
+    finally:
+        eng.stop()
+
+
+def test_add_model_refuses_slot_mode_and_bad_geometry():
+    slot_eng = InferenceEngine(_mlp_adapter(), batcher=DynamicBatcher(),
+                               metrics=ServeMetrics(), max_batch=2,
+                               kv_mode="slot", replica_id="slot-t")
+    with pytest.raises(ValueError, match="slot"):
+        slot_eng.add_model("alt", _mlp_adapter(7))
+    eng = _engine()
+    with pytest.raises(ValueError, match="max_len"):
+        eng.add_model("alt", _mlp_adapter(7, max_len=32))
+    with pytest.raises(ValueError, match="already"):
+        eng.add_model("default", _mlp_adapter(7))
+
+
+def test_swap_model_requires_stopped_engine():
+    eng = _engine().start()
+    try:
+        with pytest.raises(RuntimeError, match="stopped"):
+            eng.swap_model("default", _mlp_adapter(7), version=1)
+    finally:
+        eng.stop()
+
+
+def test_roll_zero_failures_and_post_roll_bit_identical():
+    sched = _fleet(2)
+    reg = ModelRegistry(sched)
+    reg.adopt("default")
+    reg.register("tuned", adapter=_mlp_adapter(7))
+    sched.start()
+    try:
+        new_adapter = _mlp_adapter(11)
+        reqs = []
+        for i in range(12):
+            reqs.append(Request([1, 2, 3], max_new_tokens=6,
+                                model="tuned" if i % 2 else None))
+        for r in reqs:
+            sched.submit(r)
+        moved = reg.roll("tuned", adapter=new_adapter)  # mid-storm
+        assert moved == 2
+        for r in reqs:  # zero failed requests across the roll
+            assert len(r.result(timeout=60)) == 6
+        post = Request([1, 2, 3], max_new_tokens=6, model="tuned")
+        sched.submit(post)
+        # Bit-identical to the new checkpoint served cold.
+        assert post.result(timeout=30) == _mlp_chain(new_adapter,
+                                                     [1, 2, 3], 6)
+        assert reg.models() == [
+            {"name": "default", "version": 0, "pending_version": None},
+            {"name": "tuned", "version": 1, "pending_version": None}]
+        snap = sched.metrics.snapshot()
+        assert snap["swap"]["tuned"] == {"done": 2, "total": 2}
+        assert snap["requests"].get("error", 0) == 0
+    finally:
+        sched.stop()
+
+
+def test_roll_without_weights_or_pending_raises():
+    sched = _fleet(1)
+    reg = ModelRegistry(sched)
+    reg.adopt("default")
+    with pytest.raises(KeyError):
+        reg.roll("ghost", adapter=_mlp_adapter(7))
+    with pytest.raises(ValueError, match="pending"):
+        reg.roll("default")
+
+
+def test_swap_abort_leaves_both_versions_serving_and_resumes():
+    sched = _fleet(2)
+    reg = ModelRegistry(sched)
+    reg.adopt("default")
+    old = _mlp_adapter(7)
+    new = _mlp_adapter(11)
+    reg.register("tuned", adapter=old)
+    sched.start()
+    try:
+        # Abort when the walk reaches replica-1: replica-0 swaps,
+        # replica-1 keeps the old weights and stays ALIVE.
+        fl.install(fl.FaultPlan(
+            [fl.FaultSpec("swap-abort", step=0, target="replica-1")]))
+        with pytest.raises(FaultInjected):
+            reg.roll("tuned", adapter=new)
+        fl.uninstall()
+        assert [r.state for r in sched.fleet()] == ["healthy", "healthy"]
+        versions = {r.replica_id: r.engine._model_versions["tuned"]
+                    for r in sched.fleet()}
+        assert sorted(versions.values()) == [0, 1]  # half-rolled
+        assert reg.models()[1]["pending_version"] == 1
+        # BOTH versions keep answering /generate for the variant.
+        outs = set()
+        for _ in range(8):
+            r = Request([1, 2, 3], max_new_tokens=6, model="tuned")
+            sched.submit(r)
+            outs.add(tuple(r.result(timeout=30)))
+        assert outs <= {tuple(_mlp_chain(old, [1, 2, 3], 6)),
+                        tuple(_mlp_chain(new, [1, 2, 3], 6))}
+        # Bare roll(name) resumes: only the lagging replica moves.
+        assert reg.roll("tuned") == 1
+        assert all(r.engine._model_versions["tuned"] == 1
+                   for r in sched.fleet())
+        post = Request([1, 2, 3], max_new_tokens=6, model="tuned")
+        sched.submit(post)
+        assert post.result(timeout=30) == _mlp_chain(new, [1, 2, 3], 6)
+    finally:
+        fl.uninstall()
+        sched.stop()
+
+
+# -- warmup / zero cold-start ------------------------------------------------
+
+def test_warmup_runs_at_every_start_mark_alive_regression():
+    """Regression pin (ISSUE 15 bugfix): a revived replica's engine
+    restart must RE-RUN bucket warmup — warmup only at construction
+    would make a controller-grown replica re-pay every compile on its
+    first real requests."""
+    sched = _fleet(2, warmup=True)
+    sched.start()
+    try:
+        eng = sched.fleet()[0].engine
+        assert eng.warmup_runs == 1
+        assert eng.last_warmup_ms > 0.0
+        sched.mark_dead("replica-0", reason="test revive")
+        sched.mark_alive("replica-0", reason="test revive")
+        assert eng.warmup_runs == 2            # the pin
+        r = Request([1, 2, 3], max_new_tokens=4)
+        sched.submit(r)
+        assert len(r.result(timeout=30)) == 4
+        snap = sched.metrics.snapshot()
+        assert snap["warmup"]["runs"]["replica-0"] == 2
+    finally:
+        sched.stop()
+
+
+def test_warmup_skips_busy_engine():
+    eng = _engine()
+    eng._slots[0] = object()  # simulate an in-flight sequence
+    assert eng.warmup() == 0.0
+    assert eng.warmup_runs == 0
+    eng._slots[0] = None
+
+
+def test_warmup_failure_degrades_to_cold_serving():
+    eng = _engine(warmup=True)
+    orig = eng.adapter.prefill_chunk
+    eng.adapter.prefill_chunk = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("boom"))
+    assert eng.warmup() == 0.0
+    assert eng.warmup_runs == 0
+    eng.adapter.prefill_chunk = orig
+    eng.start()
+    try:
+        r = Request([1, 2], max_new_tokens=3)
+        eng.batcher.submit(r)
+        assert len(r.result(timeout=30)) == 3  # cold but serving
+    finally:
+        eng.stop()
+
+
+def test_compile_cache_env_bootstrap(tmp_path, monkeypatch):
+    from horovod_tpu.serve import engine as eng_mod
+    monkeypatch.setenv("HVD_SERVE_COMPILE_CACHE", str(tmp_path / "xc"))
+    monkeypatch.setattr(eng_mod, "_COMPILE_CACHE_ENABLED", False)
+    eng_mod.maybe_enable_compile_cache()
+    assert (tmp_path / "xc").is_dir()
+    assert eng_mod._COMPILE_CACHE_ENABLED
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xc")
+
+
+# -- controller interaction --------------------------------------------------
+
+def test_controller_scale_up_skips_rolling_replica():
+    from horovod_tpu.serve import ControllerConfig, FleetController
+    sched = _fleet(2)
+    sched.start()
+    try:
+        ctl = FleetController(sched, config=ControllerConfig(
+            poll_s=10, min_replicas=1, max_replicas=2).validate())
+        victim = sched.fleet()[1]
+        victim.rolling = True
+        sched.mark_dead(victim.replica_id, reason="roll in flight")
+        assert ctl.snapshot().spares == 0      # not spare capacity
+        ctl._scale_up(ctl.snapshot())
+        assert victim.state == "dead"          # envelope held
+        victim.rolling = False
+        ctl._scale_up(ctl.snapshot())
+        assert victim.state == "healthy"       # normal revive works
+    finally:
+        sched.stop()
+
+
+# -- HTTP ingress ------------------------------------------------------------
+
+def test_server_tenant_and_model_ingress():
+    sched = _fleet(1)
+    reg = ModelRegistry(sched)
+    reg.adopt("default")
+    alt = _mlp_adapter(7)
+    reg.register("alt", adapter=alt)
+    server = ServeServer(sched, registry=reg, request_timeout_s=30)
+    port = server.start(port=0, host="127.0.0.1")
+    try:
+        def post(payload, headers=None):
+            conn = HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/generate", json.dumps(payload),
+                         {"Content-Type": "application/json",
+                          **(headers or {})})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            return resp.status, body
+
+        # Header tenant applies when the body has none.
+        status, body = post({"tokens": [1, 2, 3], "max_new_tokens": 2},
+                            headers={"X-Tenant-Id": "acme"})
+        assert status == 200 and body["tenant"] == "acme"
+        # Body wins over the header.
+        status, body = post({"tokens": [1, 2, 3], "max_new_tokens": 2,
+                             "tenant": "beta"},
+                            headers={"X-Tenant-Id": "acme"})
+        assert status == 200 and body["tenant"] == "beta"
+        # Invalid tenant id -> 400 (never a label / header echo).
+        status, body = post({"tokens": [1], "tenant": "eévil"})
+        assert status == 400
+        status, body = post({"tokens": [1]},
+                            headers={"X-Tenant-Id": "sp ace"})
+        assert status == 400
+        # Unknown model -> 400 with the name in the error.
+        status, body = post({"tokens": [1], "model": "ghost"})
+        assert status == 400 and "ghost" in body["error"]
+        # Known variant serves and is echoed.
+        status, body = post({"tokens": [1, 2, 3], "max_new_tokens": 4,
+                             "model": "alt"})
+        assert status == 200 and body["model"] == "alt"
+        assert body["tokens"] == _mlp_chain(alt, [1, 2, 3], 4)
+        # Tenant outcome series shows on /metrics.
+        conn = HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert 'hvd_serve_tenant_requests_total{tenant="acme",' \
+            'outcome="ok"} 1' in text
+    finally:
+        server.stop()
+
+
+# -- tenant fairness end to end ----------------------------------------------
+
+def test_e2e_weighted_goodput_tracks_weights():
+    """3 tenants at 3:2:1 on a saturated fleet: the early-completion
+    goodput share must track the weights (ISSUE 15 acceptance; the
+    bench's multitenant arm captures the same ratio in-band)."""
+    weights = {"gold": 3.0, "silver": 2.0, "bronze": 1.0}
+    cfg = TenantConfig(weights=weights, quantum=8)
+    metrics = ServeMetrics()
+    eng = InferenceEngine(_mlp_adapter(3),
+                          batcher=DynamicBatcher(tenants=cfg),
+                          metrics=metrics, max_batch=2, kv_mode="paged",
+                          replica_id="fair-0")
+    reqs = []
+    for _ in range(8):
+        for t in ("bronze", "silver", "gold"):
+            reqs.append(Request([1, 2, 3, 4, 5, 6], max_new_tokens=8,
+                                tenant=t))
+    for r in reqs:
+        eng.batcher.submit(r)
+    eng.start()
+    try:
+        stamp = {}
+        deadline = time.monotonic() + 120
+        while len(stamp) < len(reqs) and time.monotonic() < deadline:
+            now = time.monotonic()
+            for i, r in enumerate(reqs):
+                if i not in stamp and r.done:
+                    stamp[i] = now
+            time.sleep(0.001)
+        assert len(stamp) == len(reqs)
+        order = sorted(range(len(reqs)), key=lambda i: stamp[i])
+        head = [reqs[i].tenant for i in order[:12]]
+        # Exact 3:2:1 interleave is pinned by the DRR unit test above;
+        # end to end, completion stamps tie within a decode batch, so
+        # assert the dominance shape: heavy tenants fill the early
+        # half, bronze drains last.
+        assert head.count("gold") >= 4
+        assert head.count("bronze") <= 3
+        rank = {t: [] for t in weights}
+        for pos, i in enumerate(order):
+            rank[reqs[i].tenant].append(pos)
+        mean = {t: sum(v) / len(v) for t, v in rank.items()}
+        assert mean["gold"] < mean["bronze"]
+        assert mean["silver"] < mean["bronze"]
+        snap = metrics.snapshot()
+        assert set(weights) <= set(snap["tenants"])
+        for t in weights:
+            assert snap["tenants"][t]["requests"]["ok"] == 8
+    finally:
+        eng.stop()
+
+
+# -- checkpoint round-trip of serve params (satellite) -----------------------
+
+def test_checkpoint_roundtrip_unstacked_serve_params(tmp_path, hvd8):
+    """stack_block_params -> orbax save -> load_params ->
+    unstack_block_params must reproduce the adapter's ``prompt_logits``
+    BIT-identically — the registry's checkpoint_path load path serves
+    exactly these trees."""
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_len=64, causal=True,
+                            dtype=jnp.float32, scan_layers=False)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    stacked = stack_block_params(params, cfg.num_layers)
+    path = str(tmp_path / "serve-ckpt")
+    hvd8.checkpoint.save(path, {"params": stacked})
+    restored = hvd8.checkpoint.load_params(path)
+    unstacked = unstack_block_params(restored)
+    ref = TransformerAdapter(cfg, params, max_len=cfg.max_len)
+    got = TransformerAdapter(cfg, unstacked, max_len=cfg.max_len)
+    prompt = list(range(1, 12))
+    ref_logits = ref.prompt_logits(prompt)
+    got_logits = got.prompt_logits(prompt)
+    np.testing.assert_array_equal(ref_logits, got_logits)
