@@ -73,7 +73,16 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_TENANT_QUANTUM": "64",
                  "HVD_SERVE_TENANT_MAX_LABELS": "32",
                  "HVD_SERVE_COMPILE_CACHE": "",
-                 "HVD_SERVE_WARMUP": "0"}
+                 "HVD_SERVE_WARMUP": "0",
+                 "HVD_SERVE_TIER": "",
+                 "HVD_SERVE_TIER_KV": "",
+                 "HVD_SERVE_TIER_HOST_BLOCKS": "0",
+                 "HVD_SERVE_TIER_DEMOTE_ITERS": "128",
+                 "HVD_SERVE_TIER_PREFETCH": "4",
+                 "HVD_SERVE_TIER_OVERSUB": "4.0",
+                 "HVD_SERVE_TIER_QUANTUM": "8",
+                 "HVD_SERVE_TIER_FETCH_TIMEOUT_S": "2.0",
+                 "HVD_SERVE_TIER_PUBLISH": "1"}
 
 
 def _last_good_path():
@@ -1255,6 +1264,116 @@ def bench_serve():
             "requests", {}) for t in mt_weights},
     }
 
+    # -- arm 10: hvdtier tiered KV hierarchy (ISSUE 16) -----------------------
+    # Offload sub-arm: a FIXED device pool sized for ~4 concurrent
+    # untiered lifetimes, stormed with 10 long-decode requests.  The
+    # untiered engine caps in-flight at what the pool admits; the tiered
+    # engine oversubscribes, swapping cold sequences host-ward instead
+    # of preempting — acceptance: admit_ratio >= 2 at the same pool
+    # bytes, zero preemptions, outputs bit-identical.
+    from horovod_tpu.runner.http_server import (KVStoreClient,
+                                                KVStoreServer)
+    from horovod_tpu.serve import TierClient, TierConfig
+
+    tier_tokens = 24 if smoke else min(new_tokens * 2, cfg.max_len - 16)
+    tier_plen = 8
+    tier_cost = (tier_plen + tier_tokens + block_tokens - 1) \
+        // block_tokens
+    tier_pool = 4 * tier_cost
+    n_tier = 10
+    tier_prompts = [rng.randint(0, 256, size=(tier_plen,)).tolist()
+                    for _ in range(n_tier)]
+    tier_adapter = TransformerAdapter(cfg, params,
+                                      block_tokens=block_tokens)
+
+    def untiered_engine():
+        return InferenceEngine(tier_adapter, max_batch=12,
+                               kv_mode="paged", num_blocks=tier_pool,
+                               prefill_chunk=chunk,
+                               metrics=ServeMetrics(),
+                               replica_id="bench-untier")
+
+    unt_outs, _unt_dt, unt_snap, _ = timed_storm(
+        untiered_engine, tier_prompts, tier_tokens)
+
+    def tiered_engine():
+        return InferenceEngine(tier_adapter, max_batch=12,
+                               kv_mode="paged", num_blocks=tier_pool,
+                               prefill_chunk=chunk,
+                               tiering=TierConfig(oversub=4.0, quantum=2),
+                               metrics=ServeMetrics(),
+                               replica_id="bench-tiered")
+
+    tier_outs, _tier_dt, tier_snap, tier_kv = timed_storm(
+        tiered_engine, tier_prompts, tier_tokens)
+    tier_peak = tier_kv["tier"]["inflight_peak"]
+    unt_peak = unt_snap["occupancy"]["max"]
+
+    # Migration sub-arm: replica A's leader storm publishes the shared
+    # prefix chain into an in-process KV block directory; replica B
+    # (cold local cache) serves the follower storm by MIGRATING those
+    # blocks over the transport instead of re-prefilling — acceptance:
+    # B's prefix hit tokens (all migration-derived) at least match the
+    # single-replica prefix arm's, outputs == a never-tiered engine.
+    tier_srv = KVStoreServer()
+    tier_port = tier_srv.start(0)
+
+    def fleet_engine(rid):
+        client = TierClient(KVStoreClient("127.0.0.1", tier_port),
+                            replica_id=rid)
+        return InferenceEngine(prefix_adapter, max_batch=8,
+                               kv_mode="paged", num_blocks=interf_blocks,
+                               prefill_chunk=chunk, prefix_cache=True,
+                               tiering=TierConfig(quantum=2),
+                               tier_client=client,
+                               metrics=ServeMetrics(), replica_id=rid)
+
+    mig_prompts = prefix_prompts + \
+        [shared + rng.randint(0, 256, size=(3,)).tolist()
+         for _ in range(2)]
+    eng_a = fleet_engine("tier-a").start()
+    engine_storm(eng_a, mig_prompts[:1], 4)  # leader publishes
+    shared_blocks = (len(shared) - 1) // block_tokens
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            eng_a.kv_stats()["tier"]["published"] < shared_blocks:
+        time.sleep(0.02)
+    eng_b = fleet_engine("tier-b").start()
+    # First follower migrates the chain; the rest hit it locally —
+    # every B-side prefix hit exists only because of the migration.
+    mig_first = engine_storm(eng_b, mig_prompts[:1], 4)
+    mig_rest = engine_storm(eng_b, mig_prompts[1:], 4)
+    mig_kv = eng_b.kv_stats()
+    mig_stall = eng_b.metrics.snapshot()["tier"]["fault_stall"]
+    eng_a.stop()
+    eng_b.stop()
+    tier_srv.stop()
+    ref_eng = InferenceEngine(prefix_adapter, max_batch=8,
+                              kv_mode="paged", num_blocks=interf_blocks,
+                              prefill_chunk=chunk, prefix_cache=True,
+                              metrics=ServeMetrics(),
+                              replica_id="bench-mig-ref").start()
+    mig_ref = engine_storm(ref_eng, mig_prompts, 4)
+    ref_eng.stop()
+    arm_tiered = {
+        "pool_blocks": tier_pool,
+        "admitted_concurrent": tier_peak,
+        "untiered_admitted_concurrent": unt_peak,
+        "admit_ratio": round(tier_peak / max(unt_peak, 1), 3),
+        "outputs_match": tier_outs == unt_outs,
+        "preempted": tier_snap["requests"]["preempted"],
+        "untiered_preempted": unt_snap["requests"]["preempted"],
+        "swapped_out_seqs": tier_kv["tier"]["swapped_out_seqs"],
+        "spill_bytes": tier_kv["tier"]["spill_bytes"],
+        "tier_fault_stall_p50_ms": mig_stall["p50_ms"],
+        "tier_fault_stall_p99_ms": mig_stall["p99_ms"],
+        "tier_faults": mig_kv["tier"]["faults"],
+        "migrated_tokens": mig_kv["tier"]["migrated_tokens"],
+        "migrated_hit_tokens": mig_kv["prefix_hit_tokens"],
+        "migration_failures": mig_kv["tier"]["migration_failures"],
+        "migration_outputs_match": mig_first + mig_rest == mig_ref,
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -1291,6 +1410,7 @@ def bench_serve():
         "sampling": arm_sampling,
         "autoscale": arm_autoscale,
         "multitenant": arm_multitenant,
+        "tiered": arm_tiered,
     })
 
 

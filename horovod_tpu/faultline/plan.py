@@ -14,7 +14,9 @@ Vocabulary:
   ``drop-kv-response`` (control-plane transport flakes), ``poison-step``
   (an engine iteration raises mid-flight), ``slow-decode`` (a stalled
   decode step), ``pool-corrupt-block`` (a cached KV block's contents
-  become suspect and must leave the prefix registry);
+  become suspect and must leave the prefix registry),
+  ``delay-tier-fetch`` / ``drop-tier-block`` (tiered-KV prefetch /
+  migration transport flakes at the ``tier.fetch`` boundary);
 * an **injection point** names a code location that consults the plan
   (``POINTS``): the serve engine's step boundary (``engine.step``), the
   scheduler's routing path (``replica.route``), the KV client's request
@@ -42,11 +44,12 @@ from typing import Dict, List, Optional, Tuple
 
 #: Fault kinds (docs/fault_injection.md has the per-kind semantics).
 KINDS = ("kill-rank", "delay-kv", "drop-kv-response", "poison-step",
-         "slow-decode", "pool-corrupt-block", "load-spike", "swap-abort")
+         "slow-decode", "pool-corrupt-block", "load-spike", "swap-abort",
+         "delay-tier-fetch", "drop-tier-block")
 
 #: Injection points threaded through the codebase.
 POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll",
-          "ctl.poll", "registry.roll")
+          "ctl.poll", "registry.roll", "tier.fetch")
 
 #: Default injection point per kind (a spec may override, e.g. kill-rank
 #: at replica.route fires report_rank_lost directly instead of going
@@ -67,6 +70,15 @@ DEFAULT_POINT = {
     # fires BEFORE the next replica is touched, so the half-rolled fleet
     # keeps serving both versions and the roll stays resumable.
     "swap-abort": "registry.roll",
+    # The tiered-KV prefetcher's fetch boundary (serve/tiering.py):
+    # consulted once per ATTEMPT, riding the KV client's retry backoff
+    # discipline — ``delay-tier-fetch`` stalls an attempt by ``param``
+    # seconds (a prefetch losing its race shows up as a counted
+    # tier-fault stall), ``drop-tier-block`` fails it as a transport
+    # error; a train longer than HVD_KV_RETRY_MAX exhausts the fetch and
+    # the engine degrades to recompute (bit-identical by construction).
+    "delay-tier-fetch": "tier.fetch",
+    "drop-tier-block": "tier.fetch",
 }
 
 #: Step-assignment window for specs without an explicit ``@step``: drawn

@@ -3,9 +3,11 @@
 The Horovod Timeline (timeline.py) is per-process and op-centric: it
 shows WHAT each rank was doing, but a serving request that crosses the
 HTTP front-end, the router, a replica's batcher, chunked prefill, the
-decode loop, KV-transport retries, and possibly a failover resubmission
-leaves no single artifact saying where ITS latency went.  This module
-adds the per-request plane:
+decode loop, KV-transport retries, tier-fault stalls (the ``tier-fault``
+span hvdtier emits when a host/fleet KV fetch loses its prefetch race,
+serve/tiering.py), and possibly a failover resubmission leaves no single
+artifact saying where ITS latency went.  This module adds the
+per-request plane:
 
 * a :class:`TraceContext` (trace_id, span_id, parent) carried in a
   ``contextvars.ContextVar`` on the thread doing request work and ON the

@@ -25,6 +25,9 @@ Layers (docs/serving.md has the architecture):
   deficit-round-robin fairness under the QoS ordering;
 * :mod:`registry` — hvdtenant: named model variants (full weights or
   adapter deltas), variant routing, live rolling weight swap;
+* :mod:`tiering`  — hvdtier: tiered KV hierarchy (device → host RAM →
+  KV-server), ahead-of-decode prefetch, cross-replica prefix-block
+  migration via the fleet block directory;
 * :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
   ``hvdserve`` CLI;
 * :mod:`metrics` — TTFT / per-token histograms, occupancy, tokens/s.
@@ -77,4 +80,7 @@ from .replica import (  # noqa: F401
 from .server import ServeServer, run_commandline  # noqa: F401
 from .tenancy import (  # noqa: F401
     DeficitRoundRobin, TenantAccounting, TenantConfig, safe_tenant,
+)
+from .tiering import (  # noqa: F401
+    HostTier, TierClient, TierConfig, TieredBlockManager, TierWorker,
 )

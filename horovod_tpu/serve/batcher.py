@@ -520,6 +520,15 @@ class DynamicBatcher:
             taken, self._queue = self._queue, []
             return taken
 
+    def peek(self, n: int) -> List[tuple]:
+        """Non-consuming look at the next ``n`` queued requests as
+        ``(prompt, model)`` pairs — the tier prefetcher hashes these to
+        warm host-side prefix blocks ahead of admission.  Prompts are
+        copied so the caller never aliases queue-owned state."""
+        with self._cond:
+            head = self._queue[:max(n, 0)]
+            return [(list(r.prompt), r.model) for r in head]
+
     def close(self) -> List[Request]:
         with self._cond:
             self._closed = True

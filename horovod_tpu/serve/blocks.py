@@ -144,7 +144,12 @@ class BlockManager:
         """Evict the LRU retained block (caller holds the lock):
         unregister its hash and return it to the free list.  The single
         home of the registry/retained/free-list invariant — allocation
-        pressure and corruption scrubs both go through here."""
+        pressure and corruption scrubs both go through here.  Subclasses
+        that mirror the registry elsewhere (the tiered manager's host
+        copies and fleet directory entries, version-salted per model)
+        MUST hook this to reclaim those mirrors too: a peer fetching the
+        evicted chain hash after the payload is reclaimed — or after its
+        model version rolled — would serve wrong K/V silently."""
         victim, _ = self._retained.popitem(last=False)  # LRU
         del self._registry[self._hash_of[victim]]
         self._hash_of[victim] = None
@@ -213,10 +218,17 @@ class BlockManager:
             self.prefix_hit_tokens += len(ids) * self.block_tokens
             return ids, len(ids) * self.block_tokens
 
-    def register(self, chain_hash: int, block_id: int) -> None:
+    def register(self, chain_hash: int, block_id: int,
+                 salt: int = 0) -> None:
         """Publish a full immutable block for prefix reuse.  First writer
         wins: a duplicate hash (two requests prefilling the same prompt
-        concurrently) keeps the existing mapping to avoid churn."""
+        concurrently) keeps the existing mapping to avoid churn.
+
+        ``salt`` is the (model, version) chain seed the hash was built
+        under (registry.model_salt).  The base manager ignores it — the
+        hash already encodes it — but the tiered manager (tiering.py)
+        records it so spilled/published copies of the block can be
+        scrubbed per version on a weight roll."""
         if not self.prefix_cache_enabled:
             return
         with self._lock:

@@ -56,6 +56,7 @@ import math
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -69,6 +70,8 @@ from .batcher import (DeadlineExceededError, DynamicBatcher, Request,
                       bucket_requests, prompt_bucket)
 from .blocks import BlockManager, NoFreeBlocksError, chain_hashes
 from .metrics import ServeMetrics
+from .tiering import (TierClient, TierConfig, TieredBlockManager,
+                      TierWorker, make_block_io)
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -1056,7 +1059,8 @@ class _Seq:
     group's primary sequence)."""
     __slots__ = ("request", "length", "prompt_pos", "table", "hashes",
                  "admit_seq", "published", "generated", "group",
-                 "sample_index", "base_key", "parked")
+                 "sample_index", "base_key", "parked", "resident",
+                 "pending_fetch", "host_kv", "swap_step", "tier_credit")
 
     def __init__(self, request: Request, cached_tokens: int,
                  table: List[int], hashes: List[int], admit_seq: int):
@@ -1072,6 +1076,17 @@ class _Seq:
         self.sample_index = 0
         self.base_key = None             # uint32[2] seq key (sampled only)
         self.parked = False              # reserved fork slot, pre-activation
+        # Tiered-KV state (serve/tiering.py; inert defaults untiered):
+        # a non-resident sequence's K/V lives host-ward, pending_fetch
+        # maps table index -> (chain hash | swap key, issue time) of
+        # in-flight tier fetches, host_kv holds a swapped-out sequence's
+        # payloads, swap_step ages swap decisions by engine iteration,
+        # and tier_credit is the token watermark a migration admits at.
+        self.resident = True
+        self.pending_fetch: Optional[dict] = None
+        self.host_kv: Optional[list] = None
+        self.swap_step = 0
+        self.tier_credit = 0
 
     @property
     def decoding(self) -> bool:
@@ -1127,7 +1142,9 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 warmup: Optional[bool] = None):
+                 warmup: Optional[bool] = None,
+                 tiering: Optional[TierConfig] = None,
+                 tier_client=None):
         maybe_enable_compile_cache()
         self.adapter = adapter
         # Multi-model residency (serve/registry.py): named variants
@@ -1200,10 +1217,33 @@ class InferenceEngine:
                   else os.environ.get("HVD_SERVE_PREFIX_CACHE", "1")
                   not in ("0", "false"))
             bpb_fn = getattr(adapter, "paged_block_bytes", None)
-            self.blocks = BlockManager(
-                nb, bt, prefix_cache=pc,
-                bytes_per_block=int(bpb_fn()) if callable(bpb_fn)
-                else None)
+            bpb = int(bpb_fn()) if callable(bpb_fn) else None
+            # Tiered-KV hierarchy (serve/tiering.py, docs/serving.md):
+            # explicit config wins, else HVD_SERVE_TIER gates the env
+            # path.  Untiered stays a plain BlockManager — zero behavior
+            # change on every existing deployment.
+            self.tiering = (tiering if tiering is not None
+                            else TierConfig.from_env())
+            if self.tiering is not None and not self.tiering.enabled:
+                self.tiering = None
+            self._tier_client: Optional[TierClient] = None
+            if self.tiering is not None:
+                client = tier_client
+                if client is None and self.tiering.kv_addr:
+                    from ..runner.http_server import KVStoreClient
+                    host, _, port = self.tiering.kv_addr.rpartition(":")
+                    client = KVStoreClient(host or "127.0.0.1",
+                                           int(port))
+                if client is not None and not isinstance(client,
+                                                         TierClient):
+                    client = TierClient(client, replica_id=replica_id)
+                self._tier_client = client
+                self.blocks = TieredBlockManager(
+                    nb, bt, self.tiering, prefix_cache=pc,
+                    bytes_per_block=bpb, client=client)
+            else:
+                self.blocks = BlockManager(
+                    nb, bt, prefix_cache=pc, bytes_per_block=bpb)
             chunk = (prefill_chunk if prefill_chunk is not None
                      else int(os.environ.get("HVD_SERVE_PREFILL_CHUNK",
                                              "64")))
@@ -1212,11 +1252,32 @@ class InferenceEngine:
             self._chunk_budget = chunk if chunk > 0 else None
             self._cache = adapter.init_paged_cache(nb, self.max_batch)
             self._verify_pool_budget(nb)
+            if self.tiering is not None:
+                # Device IO pair + tier worker + loop-side arrival
+                # plumbing.  Arrivals are (worker → loop) messages; the
+                # deque is appended under no lock (worker) and drained
+                # at iteration top (loop) — deque.append/popleft are
+                # atomic, and _tier_event lets a stalled loop wake the
+                # moment a fetch lands instead of polling.
+                self.blocks.set_device_io(*make_block_io(self))
+                self._tier_arrivals: deque = deque()
+                self._tier_event = threading.Event()
+                self._tier_worker: Optional[TierWorker] = None
+                if self._tier_client is not None:
+                    self._tier_worker = TierWorker(
+                        self.blocks, self._tier_client,
+                        self._tier_notify, replica_id=replica_id)
+                self._tier_stall_anchor: Optional[float] = None
+                self.tier_faults = 0
+                self.inflight_peak = 0
+                self._tier_peeked: set = set()
         else:
             self._mb = 0
             self._cache = adapter.init_cache(self.max_batch)
             self.pool_bytes = self.weight_bytes = 0
             self.kv_headroom_bytes: Optional[int] = None
+            self.tiering = None
+            self._tier_client = None
         # Decode-algorithm layer (docs/serving.md sampling/spec): seeded
         # sampling + n>1 forking need the logits/sampled adapter
         # programs; speculative decoding additionally needs the
@@ -1399,6 +1460,18 @@ class InferenceEngine:
         if name not in self._adapters:
             raise KeyError(f"model {name!r} not resident")
         self._check_geometry(adapter)
+        if self.tiering is not None and name in self._model_versions:
+            # Unpublish the OLD version's fleet directory entries while
+            # _prefix_salt still yields the old salt — a peer
+            # mid-migration of the rolled chain must miss and degrade
+            # to recompute under the new weights (the version-salted
+            # eviction audit, tiering.unpublish_salt).
+            try:
+                self.blocks.unpublish_salt(self._prefix_salt(name))
+            except Exception as e:
+                get_logger().warning(
+                    "%s: tier unpublish on roll failed: %s",
+                    self.replica_id, e)
         self._adapters[name] = adapter
         self._model_versions[name] = int(version)
         if name == self.default_model:
@@ -1449,7 +1522,21 @@ class InferenceEngine:
         stats["weight_bytes"] = self.weight_bytes
         if self.kv_headroom_bytes is not None:
             stats["kv_headroom_bytes"] = self.kv_headroom_bytes
+        if self.tiering is not None and "tier" in stats:
+            # Loop-side tier counters next to the manager's: stall
+            # episodes and the oversubscription high-water mark (the
+            # tiered admit-ratio numerator in the bench).
+            stats["tier"]["faults"] = self.tier_faults
+            stats["tier"]["inflight_peak"] = self.inflight_peak
         return stats
+
+    def tier_unpublish(self) -> int:
+        """Withdraw this replica's fleet-tier directory entries (the
+        mark_dead path): a peer must never resolve a chain hash to a
+        dead holder.  Returns entries dropped (0 untiered)."""
+        if self.tiering is None:
+            return 0
+        return self.blocks.unpublish_all()
 
     # -- warmup (zero cold-start) --------------------------------------------
 
@@ -1587,6 +1674,8 @@ class InferenceEngine:
         # bucket programs are compiled.
         if self._warmup_enabled:
             self.warmup()
+        if self.tiering is not None and self._tier_worker is not None:
+            self._tier_worker.start()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"hvd-serve-engine-{self.replica_id}")
@@ -1602,6 +1691,8 @@ class InferenceEngine:
             # loop and refuse to spawn a second one next to it.
             if not self._thread.is_alive():
                 self._thread = None
+        if self.tiering is not None and self._tier_worker is not None:
+            self._tier_worker.stop()
 
     def drain(self) -> List[Request]:
         """Stop the loop and return all in-flight requests WITHOUT
@@ -2104,6 +2195,424 @@ class InferenceEngine:
                     total += g.reserve
         return total
 
+    # -- tiered-KV hierarchy (serve/tiering.py, docs/serving.md) -------------
+
+    def _tier_notify(self, msg: tuple) -> None:
+        """Worker → loop arrival (any worker thread): enqueue the
+        result and wake a stalled loop.  deque.append is atomic; the
+        loop drains at the next iteration top (_tier_schedule)."""
+        self._tier_arrivals.append(msg)
+        self._tier_event.set()
+
+    def _tier_committed_blocks(self) -> int:
+        """Worst-case lifetime blocks the DISTINCT in-flight requests
+        have committed against the oversubscribed admission budget."""
+        with self._lock:
+            seen = {id(s.request): s.request
+                    for s in self._slots if s is not None}
+        return sum(self._request_cost_blocks(r) for r in seen.values())
+
+    def _tier_plan_migration(self, seq: "_Seq") -> None:
+        """Extend ``seq``'s admission-time prefix hit fleet-wide: probe
+        the block directory for a contiguous continuation past the
+        local hit, claim device blocks for it, and stage the fetch plan
+        on ``seq.pending_fetch`` (jobs are submitted once the slot is
+        assigned).  ``tier_credit`` is the token watermark the sequence
+        will resume prefill from when every fetch lands; any failure
+        clears the plan and the blocks are simply prefilled locally —
+        bit-identical by construction."""
+        bt = self.blocks.block_tokens
+        d = len(seq.table)  # = local cached blocks at this point
+        usable = (len(seq.request.prompt) - 1) // bt
+        if d >= usable:
+            return
+        k = self.blocks.remote_hits(seq.hashes[d:usable])
+        if k <= 0:
+            return
+        try:
+            mig = self.blocks.allocate(k)
+        except NoFreeBlocksError:
+            return  # pool contended; local prefill covers it
+        seq.table.extend(mig)
+        now = time.monotonic()
+        seq.pending_fetch = {d + j: (seq.hashes[d + j], now)
+                             for j in range(k)}
+        seq.tier_credit = (d + k) * bt
+
+    def _tier_grow(self, sel):
+        """Lazy tiered allocation (the demand-paging half of the
+        oversubscribed admission): grow each selected sequence's table
+        to cover its prefill chunk, swapping younger residents host-
+        ward under pressure (_tier_relieve) and shrinking the chunk —
+        or sitting the sequence out this iteration — when the device
+        pool is truly full.  Relief victims are strictly younger than
+        their requester, so they always appear LATER in the admit-
+        ordered selection and are dropped by the resident guard before
+        their chunk is built."""
+        bt = self.blocks.block_tokens
+        out = []
+        for i, s, take in sel:
+            if not s.resident or s.pending_fetch is not None:
+                continue  # swapped out by an earlier entry's relief
+            need = ((s.prompt_pos + take - 1) // bt + 1 - len(s.table)
+                    if take > 0 else 0)
+            while need > 0:
+                try:
+                    s.table.extend(self.blocks.allocate(need))
+                    need = 0
+                except NoFreeBlocksError:
+                    if not self._tier_relieve(s):
+                        covered = len(s.table) * bt - s.prompt_pos
+                        take = max(min(take, covered), 0)
+                        need = 0
+            if take > 0:
+                out.append((i, s, take))
+        return out
+
+    def _tier_relieve(self, requester: "_Seq") -> bool:
+        """Demote-over-preempt: on pool exhaustion, swap the youngest
+        eligible RESIDENT sequence host-ward instead of preempting it
+        back to the prompt — its tokens and K/V survive, it resumes
+        after a later swap-in, and the preempted-requests counter stays
+        flat.  Eligibility: strictly younger than the requester (so a
+        relief victim can never already sit in the current pass's ok
+        list), a plain n==1 sequence (fork families pin their shared
+        blocks), not mid-fetch, and quantum-aged (no thrash)."""
+        q = self.tiering.quantum
+        with self._lock:
+            cands = [(j, t) for j, t in enumerate(self._slots)
+                     if t is not None and t is not requester
+                     and t.resident and t.group is None
+                     and t.pending_fetch is None and t.table
+                     and t.admit_seq > requester.admit_seq
+                     and (self.steps - t.swap_step) >= q]
+        if not cands:
+            return False
+        slot, victim = max(cands, key=lambda c: c[1].admit_seq)
+        self._tier_swap_out(slot, victim)
+        return True
+
+    def _tier_swap_out(self, slot: int, s: "_Seq") -> None:
+        """Move one sequence's device blocks host-ward: extract the
+        payloads (device IO, loop thread, no lock), then atomically
+        mark it non-resident and release its blocks.  Registered prompt
+        blocks become retained prefix blocks as usual — the host copy
+        only has to cover this sequence's private tail exactly."""
+        payloads = [self.blocks.extract_block(bid) for bid in s.table]
+        with self._lock:
+            if self._slots[slot] is not s:
+                return
+            s.host_kv = payloads
+            s.resident = False
+            s.swap_step = self.steps
+            table, s.table = s.table, []
+        self.blocks.free_table(table)
+        self.blocks.count_swap(out_blocks=len(table))
+        self.metrics.count_tier_bytes(
+            spill=len(table) * (self.blocks.bytes_per_block or 0))
+
+    def _tier_swap_in(self, slot: int, s: "_Seq") -> bool:
+        """Resume a swapped-out sequence: claim device blocks, insert
+        the host payloads, and issue async fetches (the ahead-of-decode
+        prefetch) for any payload that demoted to the KV tier — the
+        sequence turns resident when the last fetch lands
+        (_tier_apply), stalling the loop only if nothing else is
+        runnable meanwhile."""
+        n = len(s.host_kv) if s.host_kv else 0
+        if n == 0:
+            with self._lock:
+                if self._slots[slot] is s:
+                    s.resident = True
+                    s.swap_step = self.steps
+            return True
+        try:
+            fresh = self.blocks.allocate(n)
+        except NoFreeBlocksError:
+            q = self.tiering.quantum
+            with self._lock:
+                cands = [(j, t) for j, t in enumerate(self._slots)
+                         if t is not None and t is not s and t.resident
+                         and t.group is None and t.pending_fetch is None
+                         and t.table
+                         and (self.steps - t.swap_step) >= q]
+            if not cands:
+                return False  # nobody evictable; retry next iteration
+            vslot, victim = max(cands, key=lambda c: c[1].admit_seq)
+            self._tier_swap_out(vslot, victim)
+            try:
+                fresh = self.blocks.allocate(n)
+            except NoFreeBlocksError:
+                return False
+        now = time.monotonic()
+        pend: Dict[int, tuple] = {}
+        jobs = []
+        for idx, payload in enumerate(s.host_kv):
+            if isinstance(payload, tuple):  # ("kv", key): demoted
+                pend[idx] = (payload[1], now)
+                jobs.append(("fetch_swap", s, slot, idx, payload[1]))
+            else:
+                self.blocks.note_pending(fresh[idx], payload)
+                self.blocks.apply_pending(fresh[idx])
+        with self._lock:
+            if self._slots[slot] is not s:
+                self.blocks.free_table(fresh)
+                return False
+            s.table = fresh
+            s.host_kv = None
+            s.swap_step = self.steps
+            if pend:
+                s.pending_fetch = pend
+            else:
+                s.resident = True
+        for job in jobs:
+            self._tier_worker.submit(job)
+        if jobs:
+            # FIFO worker: the GC lands strictly after the fetches.
+            self._tier_worker.submit(("drop_swap", [j[4] for j in jobs]))
+        self.blocks.count_swap(in_blocks=n)
+        self.metrics.count_tier_bytes(
+            promote=n * (self.blocks.bytes_per_block or 0))
+        return True
+
+    def _tier_schedule(self) -> None:
+        """Iteration-top tier pass: arrivals → timeouts → rotation →
+        demotes → queue-peek prefetch (module doc in tiering.py)."""
+        self.blocks.note_step(self.steps)
+        self._tier_event.clear()
+        while self._tier_arrivals:
+            self._tier_apply(self._tier_arrivals.popleft())
+        timeout = self.tiering.fetch_timeout_s
+        now = time.monotonic()
+        with self._lock:
+            stale = [(i, s) for i, s in enumerate(self._slots)
+                     if s is not None and s.pending_fetch
+                     and any(now - t0 > timeout
+                             for _, t0 in s.pending_fetch.values())]
+        for i, s in stale:
+            self._tier_cancel_pending(i, s)
+        # Rotation: the oldest swapped-out sequence comes back when its
+        # quantum expired, or immediately when nothing resident can run
+        # (starvation-freedom: admit order bounds every wait).
+        with self._lock:
+            swapped = [(i, s) for i, s in enumerate(self._slots)
+                       if s is not None and not s.resident
+                       and s.pending_fetch is None]
+            resident_work = any(
+                s is not None and s.resident and not s.parked
+                for s in self._slots)
+        if swapped:
+            swapped.sort(key=lambda t: t[1].admit_seq)
+            i, s = swapped[0]
+            if (not resident_work
+                    or (self.steps - s.swap_step) >= self.tiering.quantum):
+                self._tier_swap_in(i, s)
+        if self._tier_worker is not None:
+            for h, entry in self.blocks.demote_candidates():
+                self._tier_worker.submit(("demote", h, entry))
+            self._tier_demote_swapped()
+            self._tier_peek()
+
+    def _tier_demote_swapped(self) -> None:
+        """Swapped-out sequences cold past HVD_SERVE_TIER_DEMOTE_ITERS
+        export their host payloads to the KV-server tier (replica-
+        private swap blobs): the payload entry becomes a ("kv", key)
+        sentinel the next swap-in resolves with an async fetch_swap.
+        The single worker queue is FIFO, so the put always lands before
+        any later fetch of the same key."""
+        di = self.tiering.demote_iters
+        with self._lock:
+            cold = [s for s in self._slots
+                    if s is not None and not s.resident
+                    and s.host_kv is not None
+                    and s.pending_fetch is None
+                    and (self.steps - s.swap_step) >= di]
+        moved = 0
+        for s in cold:
+            for idx, payload in enumerate(s.host_kv):
+                if isinstance(payload, tuple):
+                    continue
+                key = f"{self.replica_id}/{s.admit_seq}/{idx}"
+                self._tier_worker.submit(("put_swap", key, payload))
+                s.host_kv[idx] = ("kv", key)
+                moved += 1
+        if moved:
+            bpb = self.blocks.bytes_per_block or 0
+            self.blocks.count_demote(moved)
+            self.metrics.count_tier_bytes(demote=moved * bpb)
+
+    def _tier_peek(self) -> None:
+        """Queue-peek prefetch: hash the next HVD_SERVE_TIER_PREFETCH
+        queued prompts and fetch their unknown chain blocks from the
+        fleet tier into the HOST tier ahead of admission — when the
+        peek wins its race, admission's lookup_prefix promotes the
+        staged blocks synchronously and the migration never even needs
+        an in-band fetch."""
+        depth = self.tiering.prefetch
+        if depth <= 0:
+            return
+        try:
+            peeked = self.batcher.peek(depth)
+        except Exception:
+            return
+        if len(self._tier_peeked) > 4096:
+            self._tier_peeked.clear()
+        bt = self.blocks.block_tokens
+        for prompt, model in peeked:
+            usable = (len(prompt) - 1) // bt
+            if usable <= 0:
+                continue
+            hs = chain_hashes(prompt, bt,
+                              salt=self._prefix_salt(model))[:usable]
+            for h in hs:
+                if h in self._tier_peeked:
+                    continue
+                self._tier_peeked.add(h)
+                if (self.blocks.registered_block(h) is not None
+                        or self.blocks.host_contains(h)):
+                    continue
+                self._tier_worker.submit(("peek", h))
+
+    def _tier_publish(self, jobs) -> None:
+        """Ship newly completed prefix chains to the fleet tier.  The
+        payload extract is synchronous (full prefix blocks are
+        immutable, so the content is stable) but guarded: if the hash
+        unregistered between the claim and the extract (eviction /
+        spill), the publication is abandoned — the directory must
+        never point at bytes that no longer match their hash."""
+        for h, salt, bid in jobs:
+            if not self.blocks.mark_publishing(h):
+                continue
+            if self.blocks.registered_block(h) != bid:
+                self.blocks.note_published(h, salt, False)
+                continue
+            payload = self.blocks.extract_block(bid)
+            if self.blocks.registered_block(h) != bid:
+                self.blocks.note_published(h, salt, False)
+                continue
+            self._tier_worker.submit(("publish", h, salt, payload))
+
+    def _tier_apply(self, msg: tuple) -> None:
+        """Apply one worker arrival on the loop thread (the only thread
+        doing device IO).  Stale arrivals — the slot moved on, the
+        fetch was cancelled — are dropped; a None payload is a fetch
+        that exhausted its retries and degrades via cancel."""
+        kind = msg[0]
+        if kind == "staged":
+            _, h, payload, entry = msg
+            self.blocks.stage_host(h, payload, entry)
+            return
+        _, seq, slot, idx, payload = msg
+        with self._lock:
+            if (self._slots[slot] is not seq or not seq.pending_fetch
+                    or idx not in seq.pending_fetch):
+                return
+        if payload is None:
+            self._tier_cancel_pending(slot, seq)
+            return
+        bid = seq.table[idx]
+        self.blocks.note_pending(bid, payload)
+        self.blocks.apply_pending(bid)
+        done = False
+        with self._lock:
+            if self._slots[slot] is seq and seq.pending_fetch:
+                seq.pending_fetch.pop(idx, None)
+                if not seq.pending_fetch:
+                    seq.pending_fetch = None
+                    done = True
+        if done:
+            self._tier_finalize(slot, seq)
+
+    def _tier_finalize(self, slot: int, seq: "_Seq") -> None:
+        """The last in-flight fetch landed: a migration admits the
+        sequence at its credit watermark (the migrated prefix is K/V it
+        never prefills), a swap-in turns the sequence resident again.
+        Either way an open stall episode ends here."""
+        bt = self.blocks.block_tokens
+        if seq.tier_credit > 0:
+            salt = self._prefix_salt(seq.request.model)
+            gained = 0
+            with self._lock:
+                if self._slots[slot] is seq:
+                    for b in range(seq.prompt_pos // bt,
+                                   seq.tier_credit // bt):
+                        self.blocks.register(seq.hashes[b], seq.table[b],
+                                             salt=salt)
+                    gained = seq.tier_credit - seq.prompt_pos
+                    seq.prompt_pos = seq.length = seq.tier_credit
+                    seq.published = max(seq.published,
+                                        seq.tier_credit // bt)
+                    seq.tier_credit = 0
+            if gained > 0:
+                self.blocks.count_migrated(gained // bt, gained)
+                self.metrics.count_tier_migration(gained)
+        else:
+            with self._lock:
+                if self._slots[slot] is seq:
+                    seq.resident = True
+                    seq.swap_step = self.steps
+        self._tier_stall_end(seq)
+
+    def _tier_cancel_pending(self, slot: int, seq: "_Seq") -> None:
+        """A tier fetch died (dropped past the retry budget, timed out,
+        or its holder unpublished mid-flight).  A migration degrades to
+        recompute: the plan clears WITHOUT credit and chunked prefill
+        simply computes those blocks — bit-identical by construction
+        (the soak test pins it).  A swap-in has no prompt-side recovery
+        for mid-decode state, so the sequence takes the legacy preempt
+        path — restart from the prompt, equally exact."""
+        with self._lock:
+            if self._slots[slot] is not seq or seq.pending_fetch is None:
+                return
+            migration = seq.tier_credit > 0
+            seq.pending_fetch = None
+            seq.tier_credit = 0
+        if migration:
+            self.blocks.count_migration_failure()
+        else:
+            self._preempt(slot, seq)
+        self._tier_stall_end(seq)
+
+    def _tier_stall_end(self, seq: Optional["_Seq"] = None) -> None:
+        """Close an open tier-fault stall episode: count it, histogram
+        it (part of the inter-decode-step p99 contract), and emit a
+        ``tier-fault`` span on the request that resolved it."""
+        anchor = self._tier_stall_anchor
+        if anchor is None:
+            return
+        self._tier_stall_anchor = None
+        now = time.monotonic()
+        dt_ms = (now - anchor) * 1e3
+        self.tier_faults += 1
+        self.metrics.observe_tier_stall(dt_ms)
+        r = seq.request if seq is not None else None
+        if r is not None and r.trace is not None \
+                and _obs.TRACER is not None:
+            try:
+                _obs.TRACER.emit_span(
+                    r.trace, "tier-fault", anchor, now, self.replica_id,
+                    args={"stall_ms": round(dt_ms, 3)})
+            except Exception:
+                pass
+
+    def _tier_idle_wait(self, pre: int, dec: int) -> None:
+        """Stall accounting at the iteration bottom: zero progress with
+        tier fetches in flight means the loop is FAULTING on the tier —
+        the prefetch lost its race.  Anchor the episode (one fault per
+        episode, however many iterations it spans) and sleep on the
+        arrival event instead of spinning."""
+        if pre or dec:
+            self._tier_stall_anchor = None
+            return
+        with self._lock:
+            pending = any(s is not None and s.pending_fetch
+                          for s in self._slots)
+        if not pending:
+            self._tier_stall_anchor = None
+            return
+        if self._tier_stall_anchor is None:
+            self._tier_stall_anchor = time.monotonic()
+        self._tier_event.wait(timeout=0.002)
+
     def _admit_paged(self, block_s: float) -> int:
         free = self._free_slots()
         if not free:
@@ -2117,11 +2626,23 @@ class InferenceEngine:
         # reserved, not allocated (the forks grow into them at decode
         # time), so the live groups' outstanding reserves come off the
         # budget here.
+        tiered = use_blocks and self.tiering is not None
+        if tiered:
+            # Demote-over-preempt admission (serve/tiering.py): in-
+            # flight K/V beyond the device pool lives host-ward, so the
+            # budget oversubscribes the pool by HVD_SERVE_TIER_OVERSUB
+            # minus what the live requests have already committed —
+            # cold sequences swap out instead of being preempted.  The
+            # hard cap stays the DEVICE capacity: a decoding sequence
+            # must still fit the pool while resident.
+            budget = max(int(self.blocks.capacity * self.tiering.oversub)
+                         - self._tier_committed_blocks(), 0)
+        elif use_blocks:
+            budget = max(self.blocks.available()
+                         - self._reserved_blocks(), 0)
         admitted = self.batcher.get_admission(
             len(free), block_s=block_s,
-            budget=max(self.blocks.available()
-                       - self._reserved_blocks(), 0)
-            if use_blocks else None,
+            budget=budget if use_blocks else None,
             cost=self._request_cost_blocks if use_blocks else None,
             hard_cap=self.blocks.capacity if use_blocks else None)
         if not admitted:
@@ -2155,8 +2676,19 @@ class InferenceEngine:
                                           salt=self._prefix_salt(r.model))
                     cached_ids, cached_tokens = \
                         self.blocks.lookup_prefix(r.prompt, hashes=hashes)
-                need = self._blocks_for_tokens(
-                    len(r.prompt) + r.max_new_tokens) - len(cached_ids)
+                # Tiered n==1 admission is LAZY: the oversubscribed
+                # budget admitted more lifetimes than the device pool
+                # holds, so blocks are claimed chunk-by-chunk in
+                # _tier_grow (prefill) / _ensure_write_blocks (decode)
+                # — demand paging against the pool, with swap-out as
+                # the pressure valve.  n>1 families keep the eager
+                # reservation (their fork tails must never be paged
+                # out from under a live group).
+                if tiered and r.n == 1:
+                    need = 0
+                else:
+                    need = self._blocks_for_tokens(
+                        len(r.prompt) + r.max_new_tokens) - len(cached_ids)
                 try:
                     fresh = self.blocks.allocate(need) if need > 0 else []
                 except NoFreeBlocksError:
@@ -2171,6 +2703,15 @@ class InferenceEngine:
                 fresh = []
             seq = _Seq(r, cached_tokens, cached_ids + fresh, hashes,
                        self._admit_counter)
+            if (tiered and r.n == 1 and hashes
+                    and self._tier_worker is not None):
+                # Cross-replica prefix migration: where the LOCAL
+                # lookup stopped, probe the fleet block directory for
+                # a contiguous continuation and fetch those blocks
+                # over the KV transport instead of re-prefilling them.
+                # Fetches are async (the ahead-of-decode prefetcher);
+                # the sequence prefills only after they land or fail.
+                self._tier_plan_migration(seq)
             self._admit_counter += 1
             if r.sampled:
                 seq.base_key = _sampling.seq_key(r.seed, 0)
@@ -2195,7 +2736,8 @@ class InferenceEngine:
                 group.seqs.append(seq)
             r.replica_id = self.replica_id
             with self._lock:
-                self._slots[free[cursor]] = seq
+                slot = free[cursor]
+                self._slots[slot] = seq
                 cursor += 1
                 for i in range(1, r.n):
                     f = _Seq(r, 0, [], [], seq.admit_seq)
@@ -2208,6 +2750,20 @@ class InferenceEngine:
                     group.seqs.append(f)
                     self._slots[free[cursor]] = f
                     cursor += 1
+            if seq.pending_fetch:
+                # Slot is assigned — the arrivals can now verify
+                # (seq, slot) identity; issue the migration fetches.
+                for bidx, (h, _t0) in sorted(seq.pending_fetch.items()):
+                    self._tier_worker.submit(
+                        ("fetch", seq, slot, bidx, h))
+        if tiered:
+            with self._lock:
+                inflight = len({id(s.request) for s in self._slots
+                                if s is not None})
+            if inflight > self.inflight_peak:
+                # Oversubscription high-water mark — the tiered
+                # admit-ratio numerator in the bench.
+                self.inflight_peak = inflight
         return cursor
 
     def _prefill_step(self) -> int:
@@ -2218,7 +2774,8 @@ class InferenceEngine:
         with self._lock:
             pending = [(i, s) for i, s in enumerate(self._slots)
                        if s is not None and not s.parked
-                       and not s.decoding]
+                       and not s.decoding and s.resident
+                       and s.pending_fetch is None]
         if not pending:
             return 0
         pending.sort(key=lambda t: t[1].admit_seq)
@@ -2231,6 +2788,10 @@ class InferenceEngine:
             take = int(min(len(s.request.prompt) - s.prompt_pos, budget))
             sel.append((i, s, take))
             budget -= take
+        if self.tiering is not None:
+            sel = self._tier_grow(sel)
+            if not sel:
+                return 0
         chunks = [s.request.prompt[s.prompt_pos:s.prompt_pos + take]
                   for _, s, take in sel]
         starts = [s.prompt_pos for _, s, _ in sel]
@@ -2284,6 +2845,10 @@ class InferenceEngine:
                     pass
         total = 0
         bt = self.blocks.block_tokens if self.blocks is not None else 1
+        tiered = self.tiering is not None
+        publishing = (tiered and self._tier_worker is not None
+                      and self.tiering.publish)
+        pub_jobs: List[Tuple[int, int, int]] = []
         with self._lock:
             for (i, s, take), tok in zip(sel, first):
                 if self._slots[i] is not s:
@@ -2297,8 +2862,18 @@ class InferenceEngine:
                     # quadratic in prompt length; cached-hit blocks are
                     # already registered and skip via the no-op path).
                     # s.hashes is empty when prefix caching is off.
+                    # Tiered: the salt rides along (per-version scrub on
+                    # roll), and each newly completed chain becomes a
+                    # fleet-directory publication candidate — migratable
+                    # to a peer replica instead of re-prefilled there.
+                    salt = (self._prefix_salt(s.request.model)
+                            if tiered else 0)
                     for b in range(s.published, s.prompt_pos // bt):
-                        self.blocks.register(s.hashes[b], s.table[b])
+                        self.blocks.register(s.hashes[b], s.table[b],
+                                             salt=salt)
+                        if publishing:
+                            pub_jobs.append(
+                                (s.hashes[b], salt, s.table[b]))
                     s.published = max(s.published, s.prompt_pos // bt)
                 if not s.decoding:
                     continue
@@ -2325,6 +2900,8 @@ class InferenceEngine:
                 if self._seq_finished(s, tok):
                     self._retire_seq(i, s)
         self._flush_trace_emits()
+        if pub_jobs:
+            self._tier_publish(pub_jobs)
         return total
 
     def _preempt(self, slot: int, s: "_Seq") -> None:
@@ -2381,6 +2958,12 @@ class InferenceEngine:
             with self._lock:
                 if self._slots[i] is not s:
                     continue  # preempted as an earlier sequence's victim
+            if not s.resident:
+                # Swapped out host-ward as an earlier sequence's relief
+                # victim THIS pass (tiered; victims are strictly younger
+                # than their requester, so they always sort after it and
+                # are caught here before entering the ok list).
+                continue
             span = extra.get(i, 0) if extra else 0
             bt = self.blocks.block_tokens
             placed = False
@@ -2425,6 +3008,18 @@ class InferenceEngine:
                     placed = True
                     ok.append((i, s))
                 except NoFreeBlocksError:
+                    if self.tiering is not None:
+                        if self._tier_relieve(s):
+                            continue  # room made host-ward; retry arm
+                        if s.group is None and s.pending_fetch is None \
+                                and s.table:
+                            # No younger victim: the requester itself
+                            # rides out the crunch host-ward — decoded
+                            # state survives, it resumes after swap-in
+                            # (demote-over-preempt, both directions).
+                            self._tier_swap_out(i, s)
+                            placed = True
+                            continue
                     with self._lock:
                         live = [(j, t) for j, t in enumerate(self._slots)
                                 if t is not None]
@@ -2439,7 +3034,7 @@ class InferenceEngine:
     def _decode_once_paged(self) -> int:
         with self._lock:
             active = [(i, s) for i, s in enumerate(self._slots)
-                      if s is not None and s.decoding]
+                      if s is not None and s.decoding and s.resident]
         if not active:
             self._step_anchor = None
             return 0
@@ -2516,6 +3111,11 @@ class InferenceEngine:
                 if self._seq_finished(s, tok) \
                         or s.length >= self.adapter.max_len:
                     self._retire_seq(i, s)
+        if self.tiering is not None:
+            # Last-touch bookkeeping feeds the spill policy (coldest
+            # retained block first) — loop-thread-only list writes.
+            for i, s in active:
+                self.blocks.touch(s.table, self.steps)
         self.steps += 1
         self._flush_trace_emits()
         self.metrics.observe_decode_step(dt_ms, len(active), len(active))
@@ -2546,7 +3146,7 @@ class InferenceEngine:
         a rejection leaks zero block refs."""
         with self._lock:
             active = [(i, s) for i, s in enumerate(self._slots)
-                      if s is not None and s.decoding]
+                      if s is not None and s.decoding and s.resident]
         if not active:
             self._step_anchor = None
             return 0
@@ -2765,12 +3365,30 @@ class InferenceEngine:
             get_logger().warning(
                 "%s: donated KV pool was consumed by the failed step; "
                 "rebuilding pool and prefix registry", self.replica_id)
-            self.blocks = BlockManager(
-                self.blocks.capacity, self.blocks.block_tokens,
-                prefix_cache=self.blocks.prefix_cache_enabled,
-                bytes_per_block=self.blocks.bytes_per_block)
-            self._cache = self.adapter.init_paged_cache(
-                self.blocks.capacity, self.max_batch)
+            if self.tiering is not None:
+                self.blocks = TieredBlockManager(
+                    self.blocks.capacity, self.blocks.block_tokens,
+                    self.tiering,
+                    prefix_cache=self.blocks.prefix_cache_enabled,
+                    bytes_per_block=self.blocks.bytes_per_block,
+                    client=self._tier_client)
+                self._cache = self.adapter.init_paged_cache(
+                    self.blocks.capacity, self.max_batch)
+                # The insert program closes over engine._cache reads, so
+                # it survives the rebuild — but the worker holds the OLD
+                # manager; rebuild it too (same queue discipline).
+                self.blocks.set_device_io(*make_block_io(self))
+                if self._tier_worker is not None:
+                    self._tier_worker.manager = self.blocks
+            else:
+                self.blocks = BlockManager(
+                    self.blocks.capacity, self.blocks.block_tokens,
+                    prefix_cache=self.blocks.prefix_cache_enabled,
+                    bytes_per_block=self.blocks.bytes_per_block)
+                self._cache = self.adapter.init_paged_cache(
+                    self.blocks.capacity, self.max_batch)
+        if self.tiering is not None:
+            self._tier_stall_anchor = None
         self._step_anchor = None
 
     def _run(self) -> None:
@@ -2781,6 +3399,13 @@ class InferenceEngine:
                 if _faultline.PLAN is not None:
                     self._faultline_step()
                 self._expire_inflight()
+                if paged and self.tiering is not None:
+                    # Tier bookkeeping at the iteration top: apply
+                    # worker arrivals, time out dead fetches, rotate
+                    # swapped sequences back in, issue demotes and
+                    # queue-peek prefetches — all ahead of this
+                    # iteration's prefill/decode.
+                    self._tier_schedule()
                 busy = self.active_count > 0
                 # Iteration-level scheduling: admission happens BETWEEN
                 # decode steps — non-blocking while sequences are active,
@@ -2805,6 +3430,8 @@ class InferenceEngine:
                            else self._decode_once_paged())
                     if pre or dec:
                         self.metrics.observe_iteration(pre, dec)
+                    if self.tiering is not None:
+                        self._tier_idle_wait(pre, dec)
                 else:
                     self._admit(block)
                     self._decode_once()

@@ -155,6 +155,19 @@ class ServeMetrics:
         # drain-rate input of the load-aware Retry-After hint
         # (server._budget_headers).
         self._service_ms: Optional[float] = None
+        # Tiered-KV plane (serve/tiering.py): fault-stall episodes —
+        # iterations where the ahead-of-decode prefetch lost its race
+        # and the loop had nothing runnable — plus the bytes moved each
+        # direction and the migration hit counters.  The fault-stall
+        # histogram is part of the inter-decode-step p99 contract now:
+        # a tier fault IS a token-step latency event (docs/serving.md).
+        self.tier_stall_ms = Histogram()
+        self.tier_faults_total = 0
+        self.tier_spill_bytes = 0
+        self.tier_promote_bytes = 0
+        self.tier_demote_bytes = 0
+        self.tier_migrated_tokens = 0
+        self.tier_migrations_total = 0
         # Batch occupancy: sequences active per decode step.
         self.occupancy_last = 0
         self.occupancy_max = 0
@@ -323,6 +336,30 @@ class ServeMetrics:
         with self._lock:
             self.ctl_events[event] = self.ctl_events.get(event, 0) + 1
 
+    def observe_tier_stall(self, ms: float) -> None:
+        """One tier-fault stall episode (serve/tiering.py): the engine
+        loop waited ``ms`` for an in-flight tier fetch with nothing else
+        runnable — the prefetch lost its race."""
+        with self._lock:
+            self.tier_stall_ms.observe(ms)
+            self.tier_faults_total += 1
+
+    def count_tier_bytes(self, spill: int = 0, promote: int = 0,
+                         demote: int = 0) -> None:
+        """Bytes moved across tier boundaries: device→host (spill),
+        host→device (promote), host→KV-server (demote)."""
+        with self._lock:
+            self.tier_spill_bytes += spill
+            self.tier_promote_bytes += promote
+            self.tier_demote_bytes += demote
+
+    def count_tier_migration(self, tokens: int) -> None:
+        """One cross-replica prefix-block migration worth ``tokens``
+        tokens of skipped prefill."""
+        with self._lock:
+            self.tier_migrated_tokens += tokens
+            self.tier_migrations_total += 1
+
     def count_preempt_poll_error(self) -> None:
         with self._lock:
             self.preempt_poll_errors += 1
@@ -440,6 +477,15 @@ class ServeMetrics:
                         self.spec_accepted_total
                         / self.spec_drafted_total, 4)
                     if self.spec_drafted_total else 0.0,
+                },
+                "tier": {
+                    "faults": self.tier_faults_total,
+                    "fault_stall": self.tier_stall_ms.to_dict(),
+                    "spill_bytes": self.tier_spill_bytes,
+                    "promote_bytes": self.tier_promote_bytes,
+                    "demote_bytes": self.tier_demote_bytes,
+                    "migrations": self.tier_migrations_total,
+                    "migrated_tokens": self.tier_migrated_tokens,
                 },
                 "seq_forks": sum(s.get("seq_forks", 0)
                                  for s in kv.values()),
@@ -627,6 +673,37 @@ class ServeMetrics:
             rate = (self.spec_accepted_total / self.spec_drafted_total
                     if self.spec_drafted_total else 0.0)
             lines.append(f"hvd_serve_spec_acceptance_rate {rate:g}")
+            # Tiered-KV plane (serve/tiering.py): fault-stall histogram
+            # (part of the inter-decode-step p99 contract), bytes moved
+            # per direction, migration hits, and per-replica tier
+            # occupancy gauges off the manager stats.
+            hist("hvd_serve_tier_fault_stall_ms", self.tier_stall_ms,
+                 "Engine-loop stall waiting on a tier fetch that lost "
+                 "its prefetch race, ms")
+            lines.append("# TYPE hvd_serve_tier_faults_total counter")
+            lines.append(
+                f"hvd_serve_tier_faults_total {self.tier_faults_total}")
+            lines.append("# TYPE hvd_serve_tier_bytes_total counter")
+            for direction, n in (("spill", self.tier_spill_bytes),
+                                 ("promote", self.tier_promote_bytes),
+                                 ("demote", self.tier_demote_bytes)):
+                lines.append(
+                    f'hvd_serve_tier_bytes_total{{direction='
+                    f'"{direction}"}} {n}')
+            lines.append("# TYPE hvd_serve_tier_migrations_total counter")
+            lines.append(f"hvd_serve_tier_migrations_total "
+                         f"{self.tier_migrations_total}")
+            lines.append(
+                "# TYPE hvd_serve_tier_migrated_tokens_total counter")
+            lines.append(f"hvd_serve_tier_migrated_tokens_total "
+                         f"{self.tier_migrated_tokens}")
+            lines.append("# TYPE hvd_serve_tier_host_blocks gauge")
+            for rid, s in sorted(kv.items()):
+                t = s.get("tier")
+                if t is not None:
+                    lines.append(
+                        f'hvd_serve_tier_host_blocks{{replica="{rid}"}} '
+                        f'{t.get("host_blocks", 0)}')
             lines.append("# TYPE hvd_serve_prefix_cache_hit_rate gauge")
             for rid, s in sorted(kv.items()):
                 lines.append(

@@ -264,6 +264,15 @@ class ReplicaScheduler:
             req.requeues += 1  # engine.drain() bumps its own
             req.resubmitted_at = now
         orphans = queued + victim.engine.drain()
+        try:
+            # Tiered engines retract their fleet-directory entries: a
+            # peer mid-migration toward a dead holder must miss fast
+            # and degrade to recompute, not wait out fetch retries.
+            victim.engine.tier_unpublish()
+        except Exception:
+            get_logger().warning(
+                "serve: %s tier unpublish failed on mark_dead",
+                replica_id, exc_info=True)
         if not orphans:
             return
         if _obs.TRACER is not None:

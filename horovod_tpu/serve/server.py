@@ -498,7 +498,15 @@ def run_commandline(argv=None) -> int:
                         not in ("0", "false"),
                         help="run the hvdctl SLO-aware fleet controller "
                              "(HVD_SERVE_CTL_* knobs, docs/serving.md)")
+    parser.add_argument("--tier-kv", default=None, metavar="HOST:PORT",
+                        help="enable the hvdtier tiered-KV hierarchy and "
+                             "point its fleet block directory at a "
+                             "KV-server (HVD_SERVE_TIER_* knobs, "
+                             "docs/serving.md)")
     args = parser.parse_args(argv)
+    if args.tier_kv:
+        os.environ["HVD_SERVE_TIER"] = "1"
+        os.environ["HVD_SERVE_TIER_KV"] = args.tier_kv
 
     from .. import core as _core
     if not _core.is_initialized():

@@ -66,6 +66,12 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_TENANT_TOKENS", "HVD_SERVE_TENANT_QUANTUM",
                 "HVD_SERVE_TENANT_MAX_LABELS",
                 "HVD_SERVE_COMPILE_CACHE", "HVD_SERVE_WARMUP",
+                "HVD_SERVE_TIER", "HVD_SERVE_TIER_KV",
+                "HVD_SERVE_TIER_HOST_BLOCKS",
+                "HVD_SERVE_TIER_DEMOTE_ITERS", "HVD_SERVE_TIER_PREFETCH",
+                "HVD_SERVE_TIER_OVERSUB", "HVD_SERVE_TIER_QUANTUM",
+                "HVD_SERVE_TIER_FETCH_TIMEOUT_S",
+                "HVD_SERVE_TIER_PUBLISH",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
@@ -334,6 +340,28 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert mt["first_request_ms"] > 0
         for t in ("gold", "silver", "bronze"):
             assert mt["tenant_requests"][t]["ok"] >= 1
+        # ISSUE 16: the tiered arm — a fixed HBM budget stormed with
+        # long-decode requests keeps >= 2x the untiered concurrency by
+        # swapping host-ward instead of preempting (zero preemptions,
+        # bit-identical outputs), and the migration storm serves a cold
+        # replica's shared prefix from a peer's published blocks at
+        # least as well as the single-replica prefix arm did locally.
+        tiered = last["tiered"]
+        for key in ("pool_blocks", "admitted_concurrent",
+                    "untiered_admitted_concurrent", "admit_ratio",
+                    "outputs_match", "preempted", "swapped_out_seqs",
+                    "tier_fault_stall_p50_ms", "tier_fault_stall_p99_ms",
+                    "migrated_tokens", "migrated_hit_tokens",
+                    "migration_failures", "migration_outputs_match"):
+            assert key in tiered, f"tiered.{key} missing: {tiered}"
+        assert tiered["admit_ratio"] >= 2.0
+        assert tiered["outputs_match"] is True
+        assert tiered["preempted"] == 0
+        assert tiered["swapped_out_seqs"] >= 1
+        assert tiered["migration_outputs_match"] is True
+        assert tiered["migration_failures"] == 0
+        assert tiered["migrated_tokens"] > 0
+        assert tiered["migrated_hit_tokens"] >= last["prefix"]["hit_tokens"]
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -53,7 +53,7 @@ def test_lint_covers_the_whole_tree():
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
                 "server.py", "metrics.py", "paged_attention.py",
                 "sampling.py", "controller.py", "tenancy.py",
-                "registry.py"):
+                "registry.py", "tiering.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under

@@ -84,6 +84,8 @@ def test_race_walk_covers_the_threaded_tree():
                for f in files), "serve/registry.py not analyzed"
     assert any(f.endswith(os.path.join("serve", "tenancy.py"))
                for f in files), "serve/tenancy.py not analyzed"
+    assert any(f.endswith(os.path.join("serve", "tiering.py"))
+               for f in files), "serve/tiering.py not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")
@@ -99,7 +101,7 @@ def test_race_walk_covers_the_threaded_tree():
                   "BlockManager._lock", "ElasticDriver._lock",
                   "Negotiator._buf_lock", "Negotiator._flush_lock",
                   "Tracer._lock", "FleetController._lock",
-                  "ModelRegistry._lock"):
+                  "ModelRegistry._lock", "TieredBlockManager._lock"):
         assert label in analyzer.lock_sites, \
             f"{label} missing from the witness registry"
     # Condition-wraps-lock aliasing: the batcher's _cond must NOT appear

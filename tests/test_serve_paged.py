@@ -593,7 +593,8 @@ def test_prefix_registration_is_watermarked_not_quadratic():
                           prefill_chunk=BT, replica_id="wm").start()
     calls = []
     orig = eng.blocks.register
-    eng.blocks.register = lambda h, b: (calls.append(b), orig(h, b))[1]
+    eng.blocks.register = \
+        lambda h, b, salt=0: (calls.append(b), orig(h, b, salt))[1]
     try:
         prompt = list(range(6 * BT))  # 6 full blocks, 6 chunks
         eng.generate(prompt, max_new_tokens=2)
