@@ -1601,6 +1601,13 @@ def main():
         mem = getattr(reports[-1], "memory", None)
         if mem:
             record["memory_census"] = mem
+        # hvdshard rode the same trace: per-step communication plan —
+        # wire bytes per collective with the ICI/DCN fabric split and
+        # any resharding the compiler would insert (analysis/shardplan.py)
+        # — so a perf number also names the bytes it moved.
+        comm = getattr(reports[-1], "comm", None)
+        if comm:
+            record["comm_census"] = comm
     _emit(record)
 
 

@@ -24,6 +24,12 @@ Two cooperating layers (see docs/static_analysis.md):
   memory census, HVD300/302/303/304, ridden by the ``HVD_ANALYZE=1``
   hook and the serve engine's pool-budget check) and an AST half
   (``--mem``: HVD300/HVD301 donation hazards at the source level).
+* **hvdshard** (shardplan.py): static sharding/communication-plan
+  analysis — a jaxpr sharding walk (implicit-resharding detection,
+  ICI/DCN comm census, budgets, HVD400-404, ridden by the same
+  ``HVD_ANALYZE=1`` hook) plus the serve layer's
+  ``check_replica_plan()`` go/no-go, and an AST half (``--comm``:
+  HVD400/HVD404 source shapes).
 
 CLI: ``python -m horovod_tpu.analysis <paths>`` (or the ``hvdlint``
 console script / ``tools/hvdlint.py`` shim); exit 0 clean, 1 findings,
@@ -44,6 +50,12 @@ from .memplan import (MemReport, check_pool_budget,  # noqa: F401
                       measure_step_fn,
                       analyze_paths as mem_paths,
                       analyze_source as mem_source)
+from .shardplan import (CommReport, PlanVerdict,  # noqa: F401
+                        check_replica_plan, classify_mesh_axes,
+                        comm_budget_bytes, dcn_budget_bytes,
+                        measure_closed_jaxpr_comm, measure_step_fn_comm,
+                        analyze_paths as comm_paths,
+                        analyze_source as comm_source)
 from .cli import main  # noqa: F401
 from . import hook  # noqa: F401
 from . import witness  # noqa: F401

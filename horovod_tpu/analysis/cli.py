@@ -17,7 +17,10 @@ range, default paths.  Adding an analyzer means adding a row — the
 dispatch, flag wiring, select/ignore filtering (prefix-matching:
 ``--select HVD3`` runs the whole HVD3xx family), pragma handling, and
 the exit-code contract all come for free and stay identical across
-lint (HVD0xx), ``--race`` (HVD2xx), and ``--mem`` (HVD3xx).
+lint (HVD0xx), ``--race`` (HVD2xx), ``--mem`` (HVD3xx), and ``--comm``
+(HVD4xx).  ``--all`` runs every registered pass over ONE shared file
+walk, prints the combined (per-pass) output, and exits with the MAX of
+the per-pass exit codes — the one-invocation CI gate.
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 from collections import Counter
 from typing import Callable, Dict, List, Optional, Sequence
@@ -55,6 +59,11 @@ def _run_mem(paths, select, ignore):
     return analyze_paths(paths, select=select, ignore=ignore)
 
 
+def _run_comm(paths, select, ignore):
+    from .shardplan import analyze_paths
+    return analyze_paths(paths, select=select, ignore=ignore)
+
+
 @dataclasses.dataclass(frozen=True)
 class AnalyzerPass:
     """One analyzer: its CLI identity, rule family, and path walker."""
@@ -82,6 +91,13 @@ PASSES: Dict[str, AnalyzerPass] = {
         "hvdmem HBM donation hazards: donated-then-used reads and "
         "donatable-but-undonated jit args (the liveness walk itself "
         "runs trace-time under HVD_ANALYZE=1, docs/static_analysis.md)"),
+    "comm": AnalyzerPass(
+        "comm", "HVD400-HVD404",
+        _run_comm,
+        "hvdshard sharding/communication hazards: conflicting sharding "
+        "annotations (implicit resharding) and dead mesh axes (the "
+        "jaxpr sharding walk itself runs trace-time under "
+        "HVD_ANALYZE=1, docs/static_analysis.md)"),
 }
 DEFAULT_PASS = "lint"
 
@@ -90,8 +106,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdlint",
         description="Distributed-correctness static analyzers for "
-                    "horovod_tpu (default pass: AST lint HVD001-HVD009; "
-                    "--race HVD200-HVD203; --mem HVD300-HVD304; see "
+                    "horovod_tpu (default pass: AST lint HVD001-HVD011; "
+                    "--race HVD200-HVD203; --mem HVD300-HVD304; "
+                    "--comm HVD400-HVD404; --all runs every pass; see "
                     "docs/static_analysis.md)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to analyze (default: .)")
@@ -104,6 +121,11 @@ def build_parser() -> argparse.ArgumentParser:
             help=f"run the {name} pass instead ({pass_.rules}): "
                  f"{pass_.help}; same output formats, pragmas, and "
                  f"exit codes")
+    mode.add_argument(
+        "--all", action="store_true",
+        help="run EVERY registered pass "
+             f"({', '.join(PASSES)}) over one shared file walk; "
+             "combined per-pass output, exit = max of per-pass exits")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--select", type=_split_ids, default=[],
                    help="comma-separated rule IDs (or prefixes: HVD3 "
@@ -124,11 +146,65 @@ def _print_rules() -> None:
         print(f"    fix: {rule.fix_hint}")
 
 
+def _run_all(args) -> int:
+    """Every registered pass over ONE shared directory walk: the paths
+    are expanded to a concrete .py file list once (``iter_python_files``
+    is idempotent on files, so each runner reuses the walk instead of
+    re-crawling), per-pass results render under their pass name, and
+    the exit code is the MAX of the per-pass exits (2 internal error >
+    1 findings > 0 clean)."""
+    from .linter import iter_python_files
+    paths = args.paths if args.paths else ["."]
+    missing = [p for p in paths if not os.path.exists(p)]
+    shared_walk = iter_python_files(
+        [p for p in paths if os.path.exists(p)])
+    results: Dict[str, dict] = {}
+    exit_code = 0
+    for name, pass_ in PASSES.items():
+        try:
+            findings = pass_.runner(shared_walk + missing, args.select,
+                                    args.ignore)
+        except Exception as e:
+            print(f"hvdlint: internal error in pass '{name}': "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            exit_code = max(exit_code, 2)
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        active = unsuppressed(findings)
+        exit_code = max(exit_code, 1 if active else 0)
+        shown = findings if args.show_suppressed else active
+        results[name] = {
+            "findings": [f.to_dict() for f in shown],
+            "summary": {
+                "total": len(active),
+                "suppressed": len(findings) - len(active),
+                "by_rule": dict(sorted(
+                    Counter(f.rule for f in active).items())),
+            },
+        }
+        if args.format != "json":
+            for f in shown:
+                print(f.format())
+            suppressed_n = len(findings) - len(active)
+            tail = f" ({suppressed_n} suppressed)" if suppressed_n else ""
+            print(f"hvdlint [{name}]: {len(active)} finding(s){tail}")
+    if args.format == "json":
+        print(json.dumps({"pass": "all", "passes": results}, indent=1))
+    return exit_code
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
         _print_rules()
         return 0
+    if args.all:
+        try:
+            return _run_all(args)
+        except Exception as e:
+            print(f"hvdlint: internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
     chosen = [name for name in PASSES
               if name != DEFAULT_PASS and getattr(args, name, False)]
     pass_ = PASSES[chosen[0] if chosen else DEFAULT_PASS]

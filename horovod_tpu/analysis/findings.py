@@ -109,6 +109,16 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "(sampling.seq_key folds (seed, sample_index); per-token keys "
          "fold the position) so draws are reproducible and "
          "request-independent"),
+    Rule("HVD011", WARNING,
+         "blocking device sync (jax.device_get / .block_until_ready() / "
+         "np.asarray on a device value) inside a `with self._lock` "
+         "region in serve/ — the static sibling of hvdrace's HVD201: "
+         "every other request thread needing that lock stalls for the "
+         "full device round-trip, and a wedged device wedges the whole "
+         "control plane",
+         "snapshot what the sync needs under the lock, release it, then "
+         "pull the value to host (the engine's decode loop fetches "
+         "outside its critical sections — that is the model)"),
     # -- lock-order / thread-lifecycle (hvdrace static) rules ---------------
     Rule("HVD200", ERROR,
          "lock-order cycle: two code paths acquire the same pair of locks "
@@ -196,6 +206,50 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "collective result), past what the knob budgeted",
          "lower the bucket size or raise the threshold knowingly; "
          "autotune (HOROVOD_AUTOTUNE=1) finds the sweet spot"),
+    # -- hvdshard sharding / communication-plan rules -----------------------
+    Rule("HVD400", WARNING,
+         "implicit resharding: a value produced under one sharding is "
+         "consumed under another — GSPMD silently inserts the transfer "
+         "(an all-gather + re-slice in the worst case), invisible in "
+         "the source and paid every step",
+         "reshard once, explicitly (with_sharding_constraint / rebind "
+         "the constrained result to a new name), or align the producer "
+         "and consumer specs so nothing moves"),
+    Rule("HVD401", ERROR,
+         "comm-budget overshoot: the program's estimated per-step wire "
+         "bytes (payload x communicator group size, summed over every "
+         "collective plus implicit reshards) exceed "
+         "HVD_COMM_BUDGET_BYTES — or the DCN share exceeds the "
+         "stricter HVD_COMM_DCN_BUDGET_BYTES sub-budget; the step is "
+         "communication-bound before it ever runs",
+         "shard to keep traffic on ICI (cross-host axes are the slow "
+         "fabric), fuse/batch collectives, or raise the budget "
+         "knowingly"),
+    Rule("HVD402", WARNING,
+         "replicated-large-operand: a multi-MB operand rides fully "
+         "replicated next to peers sharded over a declared mesh axis "
+         "that divides its leading dim — every device holds (and every "
+         "transfer mails) a full copy a known sharding would split "
+         "(the comm analogue of HVD300's undonated buffer)",
+         "shard the operand over the peer axis (P(axis) on dim 0) and "
+         "let the consumer gather the slices it needs"),
+    Rule("HVD403", ERROR,
+         "collective over an axis no mesh declares, or one flat "
+         "collective mixing ICI and DCN axes — the first reduces over "
+         "a process set that does not exist in this deployment "
+         "(HVD102's negotiation mismatch, multi-host edition); the "
+         "second moves the whole payload at DCN speed instead of the "
+         "hierarchical ICI-then-DCN decomposition",
+         "declare the axis on the mesh, or split the collective "
+         "hierarchically: reduce over the ICI axis first, then the "
+         "DCN axis (hierarchical_allreduce is the model)"),
+    Rule("HVD404", WARNING,
+         "declared-but-never-communicated mesh axis: an axis of size "
+         "> 1 that no collective and no sharding spec ever names — "
+         "dead parallelism: the mesh reserves N x the chips and the "
+         "program replicates the same work on all of them",
+         "drop the axis from the mesh, or actually shard/reduce over "
+         "it (in_specs / out_specs / a collective naming it)"),
     # -- trace-level (jaxpr) rules -----------------------------------------
     Rule("HVD100", ERROR,
          "the step function failed to trace — the jaxpr checker reports the "
@@ -231,7 +285,8 @@ class Finding:
     severity: str = ""
     fix_hint: str = ""
     suppressed: bool = False
-    source: str = "lint"  # "lint" | "jaxpr" | "race" | "witness" | "mem"
+    # "lint" | "jaxpr" | "race" | "witness" | "mem" | "comm"
+    source: str = "lint"
 
     def __post_init__(self):
         rule = RULES.get(self.rule)

@@ -15,10 +15,16 @@ a step program first becomes visible:
   serve-phase programs get the same census + HVD1xx walk (and must
   census zero collectives — the ROADMAP-5 invariant).
 
-Every analysis also runs the hvdmem liveness walk over the SAME traced
-program (memplan.py): the memory census attaches to the report
-(``JaxprReport.memory``), HVD300/302/303/304 findings merge into its
-finding list, and ``Timeline.memory_census`` charts it.
+Every analysis also runs the hvdmem liveness walk AND the hvdshard
+sharding/communication walk over the SAME traced program (memplan.py /
+shardplan.py — no second trace): the memory census attaches as
+``JaxprReport.memory`` (HVD300/302/303/304 findings merged,
+``Timeline.memory_census`` charts it) and the comm census attaches as
+``JaxprReport.comm`` (HVD400-404 findings merged,
+``Timeline.comm_census`` charts the wire bytes with their ICI/DCN
+split).  All five serve engine build sites ride the same hook, so serve
+programs census comm too — and must census ZERO collectives, the
+ROADMAP-5 invariant.
 
 Findings are logged as warnings, the report is appended to
 ``core._state.analysis_reports`` (``core.analysis_reports()``), and the
@@ -74,7 +80,8 @@ def analyze_traceable(fn, args: Sequence[Any],
                       declared_axes: Optional[Sequence[str]] = None,
                       axis_env: Optional[Sequence[Tuple[str, int]]] = None,
                       once: bool = True,
-                      donate_argnums: Optional[Sequence[int]] = None):
+                      donate_argnums: Optional[Sequence[int]] = None,
+                      mesh=None):
     """Check ``fn(*args)``; returns the JaxprReport (or None when
     disabled/already done/failed).  ``once=True`` dedupes globally by
     ``label``; callers that own their dedup (shard_step's per-wrapper
@@ -82,7 +89,9 @@ def analyze_traceable(fn, args: Sequence[Any],
     ``once=False``.  ``donate_argnums`` is the donation the deployment
     compiles with (feeds the hvdmem HVD300 donation check; a jitted
     ``fn`` carries its own ``donated_invars``, so leave it None there).
-    Safe to call on the hot path."""
+    ``mesh`` is the deployment Mesh when the caller has one (shard_step
+    does) — it seeds the hvdshard walk's axis sizes and ICI/DCN fabric
+    classification.  Safe to call on the hot path."""
     if not enabled():
         return None
     if once:
@@ -118,6 +127,19 @@ def analyze_traceable(fn, args: Sequence[Any],
         except Exception as e:  # analysis must never break training
             log.warning("HVD_ANALYZE: memory analysis of %s failed: "
                         "%s: %s", label, type(e).__name__, e)
+        # hvdshard ride-along: sharding/communication walk of the SAME
+        # trace — implicit reshards, ICI/DCN comm census, budget rules
+        # HVD400-404.
+        try:
+            from . import shardplan
+            comm = shardplan.measure_closed_jaxpr_comm(
+                closed, label=label, mesh=mesh,
+                axis_sizes=dict(axis_env) if axis_env else None)
+            report.comm = comm.to_dict()
+            report.findings.extend(comm.findings)
+        except Exception as e:  # analysis must never break training
+            log.warning("HVD_ANALYZE: comm analysis of %s failed: "
+                        "%s: %s", label, type(e).__name__, e)
     _publish(report, log)
     return report
 
@@ -140,6 +162,9 @@ def _publish(report, log) -> None:
         mem = getattr(report, "memory", None)
         if tl is not None and mem:
             tl.memory_census(report.label, mem)
+        comm = getattr(report, "comm", None)
+        if tl is not None and comm:
+            tl.comm_census(report.label, comm)
     except Exception as e:  # pragma: no cover - publication is best-effort
         log.warning("HVD_ANALYZE: could not publish report: %s", e)
 

@@ -59,6 +59,10 @@ class JaxprReport:
     # .to_dict(); attached by the HVD_ANALYZE hook): peak_live_bytes,
     # per-primitive allocation breakdown, budget headroom.
     memory: Optional[dict] = None
+    # hvdshard sharding/communication walk of the same program
+    # (shardplan.CommReport.to_dict(); attached by the HVD_ANALYZE
+    # hook): wire bytes, ICI/DCN split, reshard events, budgets.
+    comm: Optional[dict] = None
 
     def ok(self) -> bool:
         return not self.findings
@@ -74,7 +78,8 @@ class JaxprReport:
                 "findings": [f.to_dict() for f in self.findings],
                 "census": self.census,
                 "dynamic_loops": self.dynamic_loops,
-                "memory": self.memory}
+                "memory": self.memory,
+                "comm": self.comm}
 
 
 # -- jaxpr plumbing ---------------------------------------------------------

@@ -387,6 +387,7 @@ def analyze(tree: ast.Module, path: str) -> List[Finding]:
     _rule_closed_over_mutation(mod, emit)          # HVD007
     _rule_swallowed_fault(mod, emit)               # HVD009
     _rule_serve_prng(mod, emit)                    # HVD010 (serve/ only)
+    _rule_lock_held_sync(mod, emit)                # HVD011 (serve/ only)
 
     # Dedup (nested rank-guards can flag one call twice) + stable order.
     seen, out = set(), []
@@ -722,6 +723,89 @@ def _rule_serve_prng(mod: _Module, emit) -> None:
                  f"'{last}' builds a serving key from constant(s) only — "
                  f"every request (and every rank) draws the same stream; "
                  f"derive it from the request seed (sampling.seq_key)")
+
+
+# -- HVD011: blocking device sync inside a lock region in serve/ ------------
+
+#: numpy module aliases whose ``asarray`` pulls a device value to host
+#: (a blocking sync); ``jnp.asarray`` stays on device and is fine.
+_HOST_NP_ALIASES = {"np", "numpy", "onp"}
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    """``with self._lock:`` / ``with self._kv_lock:`` — an attribute
+    whose name mentions "lock" (the repo-wide naming convention the
+    hvdrace lockgraph keys on), optionally through ``.acquire()`` or a
+    bare Name like ``with lock:``."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+        if isinstance(expr, ast.Attribute) and expr.attr == "acquire":
+            expr = expr.value
+    if isinstance(expr, ast.Attribute):
+        return "lock" in expr.attr.lower()
+    if isinstance(expr, ast.Name):
+        return "lock" in expr.id.lower()
+    return False
+
+
+def _sync_call_kind(node: ast.Call) -> Optional[str]:
+    """The blocking-sync shape of a call, if any: ``jax.device_get`` /
+    bare ``device_get``, ``<x>.block_until_ready()``, or a host-numpy
+    ``asarray`` (which forces the device value across PCIe/host DMA
+    before returning)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready":
+            return ".block_until_ready()"
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr == "asarray" and isinstance(f.value, ast.Name) and \
+                f.value.id in _HOST_NP_ALIASES:
+            return f"{f.value.id}.asarray"
+    elif isinstance(f, ast.Name) and f.id == "device_get":
+        return "device_get"
+    return None
+
+
+def _rule_lock_held_sync(mod: _Module, emit) -> None:
+    """HVD011: a blocking device→host sync inside a ``with self._lock``
+    region in serve/ — the static sibling of hvdrace's HVD201 (blocking
+    call under a lock): the sync waits for the device to finish the
+    whole in-flight program while every other request thread piles up
+    on the lock.  Nested function bodies are skipped (they run when
+    called, not necessarily under the lock)."""
+    if not _in_serve_tree(mod.path):
+        return
+    lock_withs = [
+        node for node in ast.walk(mod.tree)
+        if isinstance(node, ast.With) and
+        any(_is_lock_ctx(item.context_expr) for item in node.items)]
+
+    def _body_nodes(root_stmts):
+        stack = list(root_stmts)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # runs when called, not necessarily under lock
+            stack.extend(ast.iter_child_nodes(node))
+
+    seen: Set[int] = set()
+    for w in lock_withs:
+        for node in _body_nodes(w.body):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            kind = _sync_call_kind(node)
+            if kind is None:
+                continue
+            seen.add(id(node))
+            emit("HVD011", node,
+                 f"blocking device sync '{kind}' runs while holding "
+                 f"the lock taken on line {w.lineno} — the sync waits "
+                 f"out the device's whole in-flight program and every "
+                 f"other request thread stalls on the lock for that "
+                 f"long; snapshot under the lock, release, then fetch")
 
 
 # -- HVD007: mutation of closed-over state in traced code -------------------

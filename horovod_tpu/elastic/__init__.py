@@ -420,6 +420,25 @@ def _coordinator_for_gen(gen: str) -> Optional[str]:
     return f"{host}:{coordinator_port_for(int(base), int(w), int(c or 0))}"
 
 
+def _mark_elastic(phase: str, detail: str = "") -> None:
+    """ELASTIC timeline instant around the scale-down/scale-up barriers
+    (timeline.elastic_event): a post-mortem trace of a wedged or slow
+    reset shows WHERE the world change stalled — before the old
+    runtime's shutdown or waiting at the new world's init barrier.
+    Emitted into whatever timeline is live; never raises (a closed or
+    absent timeline must not perturb a reset)."""
+    try:
+        from .. import core as _core
+        tl = _core._state.timeline
+        if tl is not None:
+            tl.elastic_event(
+                phase,
+                int(os.environ.get("HVD_TPU_WORLD_VERSION", "0") or 0),
+                detail)
+    except Exception:  # pragma: no cover - instrumentation only
+        pass
+
+
 def _reset(refresh_world: bool = True,
            allow_same_world: bool = False) -> None:
     """Full reinit: shutdown the runtime, re-rendezvous, re-init
@@ -431,6 +450,9 @@ def _reset(refresh_world: bool = True,
     there is no new world version to wait for — the slot env is still
     valid and only the JAX runtime needs rebuilding."""
     from .. import core as _core
+    # Instant BEFORE shutdown — the old timeline is still alive here.
+    _mark_elastic("reset", "refresh-world" if refresh_world
+                  else "same-world reinit")
     _core.shutdown()
     if os.environ.get("HOROVOD_ELASTIC") == "1":
         if refresh_world:
@@ -487,6 +509,11 @@ def _reset(refresh_world: bool = True,
                 f"failed to reset the JAX backend for the new world: {e}"
             ) from e
     _core.init()
+    # Instant AFTER re-init — lands in the NEW world's timeline, so a
+    # merged trace shows the reset/world pair bracketing the barrier.
+    _mark_elastic(
+        "world",
+        f"gen={os.environ.get('HVD_TPU_NEGOTIATION_GEN', '0.0')}")
 
 
 def run(func):

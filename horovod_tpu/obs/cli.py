@@ -60,6 +60,15 @@ def run_commandline(argv: Optional[list] = None) -> int:
                              "bound")
     parser.add_argument("--json", action="store_true",
                         help="print the summary as JSON instead of text")
+    parser.add_argument("--timeline", action="append", default=[],
+                        metavar="FILE",
+                        help="also fold an in-process Timeline chrome "
+                             "trace (horovod_tpu.timeline) into the "
+                             "merged output — COLLECTIVE/MEMORY/"
+                             "COMM_CENSUS counters and ELASTIC instants "
+                             "land next to the request spans under "
+                             "their own pid (repeatable; no cross-clock "
+                             "alignment: timelines carry no wall anchor)")
     args = parser.parse_args(argv)
 
     if not os.path.isdir(args.dir):
@@ -82,6 +91,14 @@ def run_commandline(argv: Optional[list] = None) -> int:
                   f"to shard anchors", file=sys.stderr)
 
     events, meta = _merge.merge_chrome(shards)
+    for path in args.timeline:
+        if not os.path.isfile(path):
+            print(f"hvdtrace: no such timeline file: {path}",
+                  file=sys.stderr)
+            return 1
+    if args.timeline:
+        events, meta = _merge.append_timelines(events, meta,
+                                               args.timeline)
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(events, fh)

@@ -315,6 +315,59 @@ def merge_chrome(shards: List[Shard]) -> Tuple[List[dict], dict]:
     return out + timed, meta
 
 
+def load_timeline_events(path: str) -> List[dict]:
+    """In-process ``Timeline`` chrome-trace array (timeline.py) → event
+    list.  Tolerates an unterminated array (killed process: the writer
+    thread never wrote the closing bracket) by falling back to
+    line-wise parsing — the same torn-tail discipline ``load_shards``
+    applies to JSONL shards."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        evs = json.loads(text)
+    except ValueError:
+        evs = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            try:
+                evs.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail write
+    return [e for e in evs if isinstance(e, dict)]
+
+
+def append_timelines(events: List[dict], meta: dict,
+                     paths: List[str]) -> Tuple[List[dict], dict]:
+    """Fold in-process Timeline files (COLLECTIVE/MEMORY/COMM_CENSUS
+    counters, ELASTIC instants, op lifecycle) into a merged fleet trace
+    under their own pids.  Timelines carry no wall-clock anchor (their
+    ``ts`` axis is µs since Timeline open), so events keep their own
+    time base — counters and instants read fine in Perfetto per
+    process, and the metadata says which pids are unaligned rather than
+    pretending they share the request-span axis."""
+    used = {e.get("pid") for e in events if isinstance(e.get("pid"), int)}
+    next_pid = (max(used) + 1) if used else 0
+    meta = dict(meta, timelines=[])
+    for path in paths:
+        tl_events = load_timeline_events(path)
+        label = f"timeline:{os.path.basename(path)}"
+        events.append({"name": "process_name", "ph": "M",
+                       "pid": next_pid, "args": {"name": label}})
+        for ev in tl_events:
+            if ev.get("ph") == "M":
+                continue  # one process_name per file, assigned above
+            events.append(dict(ev, pid=next_pid))
+        meta["timelines"].append({
+            "label": label, "path": os.path.basename(path),
+            "events": len(tl_events), "pid": next_pid,
+            "aligned": False,
+        })
+        next_pid += 1
+    return events, meta
+
+
 def summarize(shards: List[Shard]) -> Dict[str, dict]:
     """Per-trace critical-path summaries keyed by trace id."""
     return {tid: critical_path(evs)

@@ -122,7 +122,10 @@ def shard_step(fn: Callable,
                 declared_axes=tuple(mesh.axis_names), once=False,
                 # The deployment's actual donation: hvdmem's HVD300
                 # check measures undonated-but-donatable args against it.
-                donate_argnums=donate_argnums)
+                donate_argnums=donate_argnums,
+                # The deployment's actual mesh: hvdshard's comm census
+                # reads axis sizes and the ICI/DCN fabric split off it.
+                mesh=mesh)
         return jitted(*args)
 
     return wrapper

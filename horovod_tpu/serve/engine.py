@@ -1276,6 +1276,7 @@ class InferenceEngine:
             self._cache = adapter.init_cache(self.max_batch)
             self.pool_bytes = self.weight_bytes = 0
             self.kv_headroom_bytes: Optional[int] = None
+            self.plan_verdict = None
             self.tiering = None
             self._tier_client = None
         # Decode-algorithm layer (docs/serving.md sampling/spec): seeded
@@ -1379,6 +1380,23 @@ class InferenceEngine:
         self.kv_headroom_bytes = report.headroom_bytes
         if not report.ok():
             _memplan.publish_report(report)
+        # hvdshard static go/no-go (docs/serving.md): the pool verdict
+        # above combined with the per-step comm budget (HVD401).  A
+        # data-parallel replica's serve programs census zero collectives
+        # (the ROADMAP-5 invariant) so step_comm_bytes defaults to 0 and
+        # the comm half passes trivially; a tensor/pipeline-sharded
+        # adapter declares its measured per-decode-step wire bytes.
+        from ..analysis import shardplan as _shardplan
+        self.plan_verdict = _shardplan.check_replica_plan(
+            f"serve:{self.replica_id}:plan",
+            pool_bytes=self.pool_bytes,
+            weight_bytes=self.weight_bytes,
+            step_comm_bytes=int(getattr(self.adapter,
+                                        "step_comm_bytes", 0) or 0),
+            step_dcn_bytes=int(getattr(self.adapter,
+                                       "step_dcn_bytes", 0) or 0))
+        if not self.plan_verdict.go:
+            _shardplan.publish_verdict(self.plan_verdict)
 
     # -- multi-model residency (serve/registry.py) ---------------------------
 
@@ -1522,6 +1540,15 @@ class InferenceEngine:
         stats["weight_bytes"] = self.weight_bytes
         if self.kv_headroom_bytes is not None:
             stats["kv_headroom_bytes"] = self.kv_headroom_bytes
+        # hvdshard replica-plan go/no-go (docs/serving.md): the static
+        # admission verdict from construction — pool-vs-HBM (HVD302)
+        # combined with the per-step comm budget (HVD401) — rides
+        # kv_stats so healthz + /metrics show whether this replica's
+        # plan was admitted and with how much headroom.
+        verdict = getattr(self, "plan_verdict", None)
+        if verdict is not None:
+            stats["plan_go"] = verdict.go
+            stats["plan_findings"] = len(verdict.findings)
         if self.tiering is not None and "tier" in stats:
             # Loop-side tier counters next to the manager's: stall
             # episodes and the oversubscription high-water mark (the

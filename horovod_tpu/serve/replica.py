@@ -104,9 +104,13 @@ class Replica:
             # fork counters and spec config ride healthz next to the
             # block stats, so the n-best path is visible per replica
             # from the first forked request.
+            # hvdshard go/no-go (ISSUE 17): the static replica-plan
+            # verdict (pool budget x comm budget) rides the same
+            # surface, so healthz shows plan_go per replica.
             for extra in ("pool_bytes", "weight_bytes",
                           "kv_headroom_bytes", "seq_forks",
-                          "forked_requests", "spec_k"):
+                          "forked_requests", "spec_k",
+                          "plan_go", "plan_findings"):
                 if extra in kv:
                     out["kv_blocks"][extra] = kv[extra]
         return out

@@ -95,6 +95,22 @@ COLLECTIVE_CENSUS = "COLLECTIVE_CENSUS"
 # have next to what the op lifecycle actually did with it.
 MEMORY_CENSUS = "MEMORY_CENSUS"
 
+# Static per-step COMMUNICATION census (hvdshard, analysis/
+# shardplan.py): per-collective wire bytes (payload x communicator
+# group size), the ICI vs DCN fabric split per mesh axis, implicit-
+# reshard bytes (HVD400), and the comm-budget headrooms
+# (HVD_COMM_BUDGET_BYTES / HVD_COMM_DCN_BUDGET_BYTES).  Rendered as
+# counter events so the viewer charts what a step was PLANNED to move
+# over each fabric next to the op lifecycle that moved it.
+COMM_CENSUS = "COMM_CENSUS"
+
+# Elastic world transitions (elastic/__init__.py): instant events
+# around the scale-down/scale-up barriers — reset entered (old world
+# still up), world adopted (new world initialized) — so a wedged or
+# flaky resize leaves a post-mortem trail of WHICH barrier the stall
+# sat in and which world versions were involved.
+ELASTIC = "ELASTIC"
+
 # Distributed request tracing (obs/tracing.py, docs/observability.md):
 # per-request spans render as Chrome ASYNC events ("b"/"e") keyed by the
 # request's trace_id, so one /generate call's http-handle → route →
@@ -283,6 +299,55 @@ class Timeline:
                        "ph": "C", "ts": self._ts_us(), "pid": self.rank,
                        "args": {"count": int(info.get("count", 0)),
                                 "bytes": int(info.get("bytes", 0))}})
+
+    def comm_census(self, step_name: str, comm: dict):
+        """Per-program communication census from the hvdshard walk
+        (HVD_ANALYZE=1, analysis/shardplan.py): one totals counter
+        (total/DCN wire bytes, reshard bytes, budget headrooms), one
+        counter per collective primitive, and one per mesh axis with
+        its ICI/DCN fabric — mirroring ``memory_census``."""
+        totals = {"total_wire_bytes": int(comm.get("total_wire_bytes", 0)),
+                  "dcn_wire_bytes": int(comm.get("dcn_wire_bytes", 0)),
+                  "reshard_bytes": int(comm.get("reshard_bytes", 0))}
+        if comm.get("headroom_bytes") is not None:
+            totals["headroom_bytes"] = int(comm["headroom_bytes"])
+        if comm.get("dcn_headroom_bytes") is not None:
+            totals["dcn_headroom_bytes"] = int(comm["dcn_headroom_bytes"])
+        self._put({"name": f"{COMM_CENSUS}/{step_name}", "ph": "C",
+                   "ts": self._ts_us(), "pid": self.rank, "args": totals})
+        by_prim = comm.get("by_primitive") or {}
+        for prim in sorted(by_prim):
+            info = by_prim[prim]
+            self._put({"name": f"{COMM_CENSUS}/{step_name}/{prim}",
+                       "ph": "C", "ts": self._ts_us(), "pid": self.rank,
+                       "args": {"count": int(info.get("count", 0)),
+                                "bytes": int(info.get("bytes", 0)),
+                                "wire_bytes":
+                                    int(info.get("wire_bytes", 0)),
+                                "dcn_bytes":
+                                    int(info.get("dcn_bytes", 0))}})
+        by_axis = comm.get("by_axis") or {}
+        for axis in sorted(by_axis):
+            info = by_axis[axis]
+            self._put({"name":
+                       f"{COMM_CENSUS}/{step_name}/axis/{axis}"
+                       f"[{info.get('fabric', 'ici')}]",
+                       "ph": "C", "ts": self._ts_us(), "pid": self.rank,
+                       "args": {"count": int(info.get("count", 0)),
+                                "wire_bytes":
+                                    int(info.get("wire_bytes", 0)),
+                                "size": int(info.get("size", 1))}})
+
+    def elastic_event(self, phase: str, version: int, detail: str = ""):
+        """One elastic world transition (elastic/__init__.py):
+        process-scoped instant event carrying the phase (``reset`` when
+        the old world starts tearing down, ``world`` when the new one is
+        adopted) and the world version — the post-mortem breadcrumbs a
+        flaky scale-down/scale-up run leaves around its barriers."""
+        self._put({"name": f"{ELASTIC}/{phase}", "ph": "i", "s": "p",
+                   "ts": self._ts_us(), "pid": self.rank, "tid": "elastic",
+                   "args": {"world_version": int(version),
+                            "detail": detail}})
 
     def serve_counter(self, component: str, values: dict):
         """Serving-engine counter sample (serve/metrics.py): ``values``

@@ -1,0 +1,193 @@
+"""PASSES-registry contract + ``--all`` mode (ISSUE 17 satellite).
+
+Five analyzer families share ONE CLI front end (analysis/cli.py).  This
+file pins the contract pieces that belong to the registry itself rather
+than to any single pass:
+
+* mutual exclusion — ``--race --mem`` etc. is a usage error (exit 2);
+* prefix ``--select``/``--ignore`` reaches every family uniformly
+  (``--select HVD4`` routes to the comm rules and nothing else);
+* ``--comm`` honors the exact text / ``--format json`` / exit 0-1-2 /
+  pragma contract the other passes already test for themselves;
+* ``--all`` runs every registered pass over one shared walk, prints
+  combined per-pass output, and exits with the MAX of per-pass exits.
+"""
+
+import json
+
+import pytest
+
+from horovod_tpu.analysis.cli import PASSES, build_parser, main as cli_main
+
+DIRTY_COMM = """\
+import jax
+from jax.sharding import PartitionSpec as P
+
+def step(x):
+    a = jax.lax.with_sharding_constraint(x, P("dp"))
+    b = jax.lax.with_sharding_constraint(x, P(None, "tp"))
+    return a + b
+"""
+
+DIRTY_LINT = """\
+import horovod_tpu as hvd
+
+def train():
+    if hvd.rank() == 0:
+        hvd.allreduce_("x", 1.0)
+"""
+
+CLEAN = "x = 1\n"
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    (tmp_path / "dirty_comm.py").write_text(DIRTY_COMM)
+    (tmp_path / "dirty_lint.py").write_text(DIRTY_LINT)
+    (tmp_path / "clean.py").write_text(CLEAN)
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# Registry contract
+# ---------------------------------------------------------------------------
+
+def test_registry_has_all_five_families():
+    assert list(PASSES) == ["lint", "race", "mem", "comm"]
+    # lint is the default pass (no flag); the other three get --<name>
+    ranges = {name: p.rules for name, p in PASSES.items()}
+    assert ranges["comm"] == "HVD400-HVD404"
+    assert ranges["mem"] == "HVD300-HVD304"
+
+
+def test_pass_flags_are_mutually_exclusive(capsys):
+    parser = build_parser()
+    for combo in (["--race", "--mem"], ["--comm", "--race"],
+                  ["--all", "--comm"]):
+        with pytest.raises(SystemExit) as e:
+            parser.parse_args(combo + ["."])
+        assert e.value.code == 2
+        capsys.readouterr()
+
+
+def test_prefix_select_routes_to_comm_family_only(corpus, capsys):
+    # HVD4 prefix → the comm pass fires on the comm corpus...
+    assert cli_main(["--comm", "--select", "HVD4", str(corpus)]) == 1
+    # ...a non-member rule id selects nothing there...
+    assert cli_main(["--comm", "--select", "HVD404", str(corpus)]) == 0
+    # ...and the same prefix under the lint pass matches no lint rule.
+    assert cli_main(["--select", "HVD4", str(corpus)]) == 0
+    assert cli_main(["--comm", "--ignore", "HVD4", str(corpus)]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# --comm single-pass contract (text / json / exits / pragma)
+# ---------------------------------------------------------------------------
+
+def test_comm_text_output_and_exit_one(corpus, capsys):
+    rc = cli_main(["--comm", str(corpus)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD400" in out
+    assert "dirty_comm.py:6" in out
+    assert "hvdlint: 1 finding(s)" in out
+
+
+def test_comm_clean_file_exits_zero(corpus, capsys):
+    assert cli_main(["--comm", str(corpus / "clean.py")]) == 0
+    capsys.readouterr()
+
+
+def test_comm_json_schema(corpus, capsys):
+    rc = cli_main(["--comm", "--format", "json", str(corpus)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["pass"] == "comm"
+    assert payload["summary"]["by_rule"] == {"HVD400": 1}
+    (f,) = payload["findings"]
+    assert (f["rule"], f["line"]) == ("HVD400", 6)
+    assert f["path"].endswith("dirty_comm.py")
+
+
+def test_comm_unreadable_path_is_finding_not_crash(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    assert cli_main(["--comm", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "HVD000" in out
+
+
+def test_comm_nonexistent_path_exits_one(capsys):
+    assert cli_main(["--comm", "/nonexistent/hvdshard/path"]) == 1
+    capsys.readouterr()
+
+
+def test_comm_pragma_suppression_and_show_suppressed(tmp_path, capsys):
+    src = DIRTY_COMM.replace(
+        '    b = jax.lax.with_sharding_constraint(x, P(None, "tp"))',
+        '    b = jax.lax.with_sharding_constraint(x, P(None, "tp"))'
+        '  # hvdlint: disable=HVD400')
+    f = tmp_path / "sup.py"
+    f.write_text(src)
+    assert cli_main(["--comm", str(f)]) == 0
+    assert "1 suppressed" in capsys.readouterr().out
+    cli_main(["--comm", "--show-suppressed", str(f)])
+    assert "HVD400" in capsys.readouterr().out
+
+
+def test_list_rules_includes_hvd4xx(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("HVD400", "HVD401", "HVD402", "HVD403", "HVD404",
+                 "HVD011"):
+        assert rule in out, rule
+
+
+# ---------------------------------------------------------------------------
+# --all combined mode
+# ---------------------------------------------------------------------------
+
+def test_all_exit_is_max_of_pass_exits(corpus, capsys):
+    """Corpus dirties lint AND comm; race/mem are clean — combined exit
+    is 1, and the per-pass blocks each report their own counts."""
+    rc = cli_main(["--all", str(corpus)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "hvdlint [lint]: 1 finding(s)" in out
+    assert "hvdlint [race]: 0 finding(s)" in out
+    assert "hvdlint [mem]: 0 finding(s)" in out
+    assert "hvdlint [comm]: 1 finding(s)" in out
+
+
+def test_all_clean_tree_exits_zero(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text(CLEAN)
+    assert cli_main(["--all", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for name in PASSES:
+        assert f"hvdlint [{name}]: 0 finding(s)" in out
+
+
+def test_all_json_combines_per_pass_payloads(corpus, capsys):
+    rc = cli_main(["--all", "--format", "json", str(corpus)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["pass"] == "all"
+    assert set(payload["passes"]) == set(PASSES)
+    assert payload["passes"]["comm"]["summary"]["by_rule"] == \
+        {"HVD400": 1}
+    assert payload["passes"]["lint"]["summary"]["total"] == 1
+    assert payload["passes"]["race"]["summary"]["total"] == 0
+
+
+def test_all_select_narrows_every_pass(corpus, capsys):
+    """--select HVD4 under --all: only the comm family can fire, so the
+    lint finding disappears and the exit reflects comm alone."""
+    rc = cli_main(["--all", "--select", "HVD4", str(corpus)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "hvdlint [lint]: 0 finding(s)" in out
+    assert "hvdlint [comm]: 1 finding(s)" in out
+    assert cli_main(["--all", "--ignore", "HVD0,HVD4",
+                     str(corpus)]) == 0
+    capsys.readouterr()

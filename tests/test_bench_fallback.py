@@ -77,7 +77,9 @@ def _bench_env(tag, **overrides):
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
                 "HVD_TRACE_SAMPLE", "HVD_TRACE_DIR", "HVD_TRACE_RECENT",
                 "HVD_TIMELINE_QUEUE_CAP", "HVD_ANALYZE",
-                "HVD_MEM_BUDGET_BYTES", "HVD_MEM_UPCAST_MIN_BYTES"):
+                "HVD_MEM_BUDGET_BYTES", "HVD_MEM_UPCAST_MIN_BYTES",
+                "HVD_COMM_BUDGET_BYTES", "HVD_COMM_DCN_BUDGET_BYTES",
+                "HVD_COMM_DCN_AXES"):
         env.pop(var, None)
     env["HVD_TPU_BENCH_TAG"] = tag
     env["BENCH_PROBE_BUDGET_S"] = "3"
@@ -371,6 +373,7 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
             pass
 
 
+@pytest.mark.slow  # ~67s: real train capture; smoke test covers tier-1
 def test_fresh_capture_supersedes_stale(tmp_path):
     """The SUCCESS path, end-to-end on CPU (BENCH_SMOKE shapes): the
     emit-first stale line prints first, the probe succeeds, a real train
@@ -413,6 +416,13 @@ def test_fresh_capture_supersedes_stale(tmp_path):
         assert mem["peak_live_bytes"] > 0
         assert mem["input_bytes"] > 0
         assert mem["by_primitive"]
+        # ... and the hvdshard sharding walk (analysis/shardplan.py)
+        # rode the same trace too: wire bytes per collective + per mesh
+        # axis land under comm_census.
+        comm = last["comm_census"]
+        assert comm["by_primitive"]["psum"]["wire_bytes"] > 0
+        assert comm["total_wire_bytes"] > 0
+        assert comm["axes_declared"]
         with open(path) as f:
             persisted = json.load(f)
         assert persisted["value"] == last["value"]  # persisted for next time

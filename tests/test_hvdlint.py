@@ -440,6 +440,78 @@ def test_hvd010_request_derived_keys_are_clean_and_rule_is_serve_scoped():
     assert [r for r, _ in _serve_fired(not_prng)] == []
 
 
+def test_hvd011_sync_under_lock_three_shapes():
+    """Each blocking-sync spelling fires under ``with self._lock``:
+    .block_until_ready(), jax.device_get / bare device_get, and the
+    host-numpy asarray that DMAs the value off the device."""
+    src = """\
+    import jax
+    import numpy as np
+
+    class Engine:
+        def snapshot(self):
+            with self._lock:
+                out = self._logits.block_until_ready()
+                host = jax.device_get(self._kv)
+                arr = np.asarray(self._cache)
+            return out, host, arr
+    """
+    assert _serve_fired(src) == [("HVD011", 7), ("HVD011", 8),
+                                 ("HVD011", 9)]
+
+
+def test_hvd011_snapshot_then_fetch_is_clean_and_serve_scoped():
+    """The fix idiom — take the device reference under the lock,
+    release, then sync — is clean; jnp.asarray stays on device; and the
+    same dirty shape OUTSIDE serve/ (training checkpoint code blocks
+    the only thread anyway) never fires."""
+    clean = """\
+    import jax
+    import jax.numpy as jnp
+
+    class Engine:
+        def snapshot(self):
+            with self._lock:
+                ref = self._logits
+                dev = jnp.asarray(self._cache)
+            return jax.device_get(ref), dev
+    """
+    assert _serve_fired(clean) == []
+    dirty_elsewhere = """\
+    import jax
+
+    def checkpoint(state, lock):
+        with lock:
+            return jax.device_get(state)
+    """
+    assert fired(dirty_elsewhere) == []
+
+
+def test_hvd011_nested_defs_and_acquire_spelling():
+    """A nested function defined (not called) under the lock runs later,
+    possibly lock-free — skipped; ``with self._kv_lock.acquire()`` and a
+    bare ``with lock:`` both count as lock regions."""
+    src = """\
+    import jax
+
+    class Engine:
+        def deferred(self):
+            with self._lock:
+                def fetch():
+                    return jax.device_get(self._kv)
+                self._pending = fetch
+            return self._pending
+
+        def direct(self, lock):
+            with self._kv_lock.acquire():
+                a = jax.device_get(self._kv)
+            with lock:
+                b = self._x.block_until_ready()
+            return a, b
+    """
+    assert _serve_fired(src) == [("HVD011", 13), ("HVD011", 15)]
+
+
 def test_join_collective_requires_hvd_base():
     """os.path.join / ','.join / thread.join must not read as the hvd.join
     collective (the false positives the first dogfooding run surfaced)."""

@@ -185,6 +185,7 @@ print(f"rank{{r}} STRESS OK after {{ROUNDS}} rounds")
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # ~9s stress loop
 def test_join_cached_dispatch_stress(tmp_path):
     """VERDICT r1 item 2: interleave cache-HIT dispatches with joins across
     3 processes for 40 rounds (~160 collectives racing join markers).  The

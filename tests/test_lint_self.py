@@ -70,6 +70,11 @@ def test_lint_covers_the_whole_tree():
     # the lint the rest of the repo is held to.
     assert any(f.endswith(os.path.join("analysis", "memplan.py"))
                for f in files), "analysis/memplan.py not linted"
+    # And the hvdshard analyzer (ISSUE 17): shardplan.py must pass the
+    # same lint — including the HVD011 sync-under-lock rule it shipped
+    # beside.
+    assert any(f.endswith(os.path.join("analysis", "shardplan.py"))
+               for f in files), "analysis/shardplan.py not linted"
     assert not any("__pycache__" in f for f in files)
 
 

@@ -54,7 +54,8 @@ def test_mem_walk_covers_the_donating_tree():
                 os.path.join("serve", "registry.py"),
                 os.path.join("serve", "tiering.py"),
                 os.path.join("parallel", "__init__.py"),
-                os.path.join("analysis", "memplan.py")):
+                os.path.join("analysis", "memplan.py"),
+                os.path.join("analysis", "shardplan.py")):
         assert any(f.endswith(mod) for f in files), f"{mod} not analyzed"
     assert not any("__pycache__" in f for f in files)
 

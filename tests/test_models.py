@@ -21,6 +21,7 @@ TINY = TransformerConfig(vocab_size=128, num_layers=2, num_heads=8,
                          dtype=jnp.float32)
 
 
+@pytest.mark.slow  # ~9s: full resnet50 build; fused-bn test keeps resnet in tier-1
 def test_resnet50_forward_shape(hvd8):
     model = create_resnet50(num_classes=10, dtype=jnp.float32)
     x = jnp.ones((2, 32, 32, 3))

@@ -125,6 +125,7 @@ def test_kv_long_poll_blocks_until_put():
         srv.stop()
 
 
+@pytest.mark.slow  # ~7s scale smoke
 def test_control_plane_scale_smoke():
     """Regression guard for the round-3 control-plane fixes (Nagle stall,
     polling saturation).  Budgets are loose — this box has ONE core shared

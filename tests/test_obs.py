@@ -215,6 +215,60 @@ def test_hvdtrace_cli_contract(tmp_path, capsys):
     assert summary["traces"][tid]["total_ms"] == pytest.approx(50.0)
 
 
+def test_hvdtrace_folds_timeline_files(tmp_path, capsys):
+    """--timeline folds an in-process Timeline chrome trace (carrying
+    COMM_CENSUS counters + ELASTIC instants) into the merged fleet
+    trace under a fresh pid, and meta marks it unaligned (timelines
+    have no wall anchor)."""
+    from horovod_tpu.timeline import Timeline
+    tid = "ab" * 8
+    _write_shard(
+        tmp_path / "trace-1234-server.jsonl", "server", 0, 0,
+        [{"type": "span", "trace": tid, "span": "aaaaaaaa",
+          "parent": None, "name": "http-handle", "proc": "server",
+          "t0_ns": 0, "t1_ns": 50_000_000, "args": {}}])
+    tl_path = tmp_path / "rank0_timeline.json"
+    tl = Timeline(str(tl_path), rank=0)
+    tl.comm_census("step", {"total_wire_bytes": 4096, "dcn_wire_bytes": 0,
+                            "reshard_bytes": 0, "by_primitive": {},
+                            "by_axis": {}})
+    tl.elastic_event("reset", 3, "refresh-world")
+    tl.close()
+    out = tmp_path / "merged.json"
+    assert hvdtrace_cli(["--dir", str(tmp_path), "-o", str(out),
+                         "--timeline", str(tl_path), "--json"]) == 0
+    out_text = capsys.readouterr().out
+    printed = json.loads(out_text[out_text.index("{"):])
+    (tl_meta,) = printed["meta"]["timelines"]
+    assert tl_meta["label"] == "timeline:rank0_timeline.json"
+    assert tl_meta["aligned"] is False and tl_meta["events"] > 0
+    merged = json.load(open(out))
+    span_pids = {e["pid"] for e in merged
+                 if e.get("name") == "http-handle"}
+    comm = [e for e in merged
+            if e.get("name") == "COMM_CENSUS/step" and e.get("ph") == "C"]
+    elastic = [e for e in merged
+               if e.get("name", "").startswith("ELASTIC/")]
+    assert comm and elastic
+    assert comm[0]["pid"] == tl_meta["pid"]
+    assert comm[0]["pid"] not in span_pids  # own process lane
+    assert comm[0]["args"]["total_wire_bytes"] == 4096
+    # A missing timeline file is a usage failure, not a silent skip.
+    assert hvdtrace_cli(["--dir", str(tmp_path), "--timeline",
+                         str(tmp_path / "nope.json")]) == 1
+    capsys.readouterr()
+
+
+def test_load_timeline_events_tolerates_torn_tail(tmp_path):
+    """A SIGKILLed writer leaves the chrome array unterminated — the
+    loader falls back to line-wise parsing and keeps whole events."""
+    p = tmp_path / "torn.json"
+    p.write_text('[\n{"name": "A", "ph": "C", "ts": 1, "args": {}},\n'
+                 '{"name": "B", "ph": "i", "ts": 2, "arg')
+    evs = mg.load_timeline_events(str(p))
+    assert [e["name"] for e in evs] == ["A"]
+
+
 def test_kv_clock_anchor_roundtrip():
     """publish_clock_anchor → kv_anchors → apply_kv_anchors attaches the
     RTT skew bound the merge reports (the rendezvous-KV estimation

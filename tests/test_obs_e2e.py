@@ -64,6 +64,7 @@ def _metric_lines(port, name):
     return [l for l in text.splitlines() if l.startswith(name)]
 
 
+@pytest.mark.slow  # ~25s storm; unit-level merge coverage lives in test_obs.py
 def test_traced_storm_with_failover_merges_across_replicas(
         hvd8, tmp_path):
     shard_dir = tmp_path / "shards"

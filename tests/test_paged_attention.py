@@ -244,6 +244,7 @@ def test_poisoned_pool_block_never_leaks_through_clip_mask(impl):
 
 # -- engine-level kernel-vs-gather parity -------------------------------------
 
+@pytest.mark.slow  # ~30s sweep; batched-equals-single kernel parity stays
 def test_kernel_engine_matches_gather_engine_at_block_boundaries():
     """Token-stream parity across prompt lengths straddling block and
     jit-bucket boundaries (k·BT, k·BT±1), chunk budget unaligned with
@@ -379,6 +380,7 @@ def test_kernel_engine_pool_exhaustion_preempts_youngest():
 
 # -- quantized KV through the engine ------------------------------------------
 
+@pytest.mark.slow  # ~18s
 def test_int8_engine_error_bounds_and_batched_equals_single():
     """int8 KV blocks: batched==single inside the int8 engine (the
     exactness contract at any storage dtype), and final logits within
@@ -417,6 +419,7 @@ def test_int8_engine_error_bounds_and_batched_equals_single():
         eng.stop()
 
 
+@pytest.mark.slow  # ~10s dtype sweep
 def test_prefix_cache_hashing_unaffected_by_storage_dtype():
     """Prefix hashes are token-content based, so int8 storage reuses
     cached blocks exactly like bf16 — same hit tokens, identical output

@@ -139,6 +139,7 @@ def test_subset_collectives_64_devices():
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # ~6s; 64-device variant stays in tier-1
 def test_subset_collectives_128_devices():
     _run_case(128)
 

@@ -86,6 +86,10 @@ def test_race_walk_covers_the_threaded_tree():
                for f in files), "serve/tenancy.py not analyzed"
     assert any(f.endswith(os.path.join("serve", "tiering.py"))
                for f in files), "serve/tiering.py not analyzed"
+    # The hvdshard analyzer (ISSUE 17) is lock-free by design (pure AST
+    # + jaxpr walks) — checked only if the walker visits it.
+    assert any(f.endswith(os.path.join("analysis", "shardplan.py"))
+               for f in files), "analysis/shardplan.py not analyzed"
     for path in files:
         with open(path, "rb") as fh:
             src = fh.read().decode("utf-8", errors="replace")

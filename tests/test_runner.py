@@ -720,6 +720,7 @@ exit $rc
 """
 
 
+@pytest.mark.slow  # ~8s mock-jsrun e2e; static/ssh launch paths stay in tier-1
 def test_jsrun_launch_end_to_end(monkeypatch, tmp_path):
     """--jsrun inside a (mocked) LSF allocation: hosts come from LSF env,
     ONE jsrun invocation covers both ranks, the shim maps JSM ranks onto

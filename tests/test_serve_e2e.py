@@ -65,6 +65,7 @@ def _metric_value(text, name):
     raise AssertionError(f"{name} not in /metrics:\n{text}")
 
 
+@pytest.mark.slow  # ~30s concurrent-load soak
 def test_serving_e2e_concurrent_load_and_replica_loss(hvd8):
     model = Transformer(CFG)
     params = model.init(jax.random.PRNGKey(0),

@@ -195,6 +195,7 @@ def test_batcher_hard_cap_pops_impossible_requests():
 
 # -- engine: exactness under paged + chunked ---------------------------------
 
+@pytest.mark.slow  # ~13s; non-chunked flax parity stays in tier-1
 def test_paged_chunked_matches_flax_at_block_boundaries():
     """Greedy decode through the paged cache with a chunk budget that is
     deliberately unaligned with the block size must match the full
@@ -329,6 +330,7 @@ def _interference_run(params, prefill_chunk):
     return run()
 
 
+@pytest.mark.slow  # ~33s latency soak
 def test_chunked_prefill_keeps_decode_flowing_and_p99_bounded():
     """ISSUE 5 acceptance: while a ~max_len prompt prefills in chunks,
     in-flight decodes keep stepping between chunks (structural proof) and

@@ -221,6 +221,7 @@ def test_retained_eviction_drops_directory_entry(kv_world):
     assert peer.remote_hits([h]) == 0
 
 
+@pytest.mark.slow  # ~9s
 def test_roll_mid_migration_misses_and_degrades(kv_world, tiny_params):
     """unpublish_salt (the weight-roll hook) mid-migration: the peer's
     directory probe of the OLD version's chain must miss — it
@@ -248,6 +249,7 @@ def test_roll_mid_migration_misses_and_degrades(kv_world, tiny_params):
 
 # -- engine: demote-over-preempt ---------------------------------------------
 
+@pytest.mark.slow  # ~18s capacity comparison
 def test_demote_over_preempt_admits_more_at_same_pool_bytes(tiny_params):
     """The tentpole's perf claim at unit scale: with an identical device
     pool, the tiered engine keeps strictly more requests IN FLIGHT than
@@ -315,6 +317,7 @@ def test_demote_over_preempt_admits_more_at_same_pool_bytes(tiny_params):
 
 # -- engine: cross-replica migration -----------------------------------------
 
+@pytest.mark.slow  # ~11s block-boundary sweep
 def test_migration_matches_local_prefill_at_block_boundaries(
         kv_world, tiny_params):
     """Follower outputs through migrated prefix blocks == local
@@ -345,6 +348,7 @@ def test_migration_matches_local_prefill_at_block_boundaries(
         base.stop(); ea.stop(); eb.stop()
 
 
+@pytest.mark.slow  # ~7s
 def test_prefetch_race_stall_is_counted_and_histogrammed(
         kv_world, tiny_params):
     """A delayed tier fetch the decode loop has to WAIT on is exactly
@@ -375,6 +379,7 @@ def test_prefetch_race_stall_is_counted_and_histogrammed(
         base.stop(); ea.stop(); eb.stop()
 
 
+@pytest.mark.slow  # ~8s
 def test_drop_tier_block_train_degrades_to_recompute_bit_identical(
         kv_world, tiny_params):
     """Satellite soak: a drop train longer than the KV retry budget

@@ -94,6 +94,7 @@ def test_estimator_requires_args():
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # ~14s; validation/early-stopping tests cover the estimator in tier-1
 def test_estimator_fit_transform_mnist_mlp(tmp_path):
     """VERDICT r1 item 3 'done' bar: train an MNIST-scale MLP through the
     estimator — DataFrame → Parquet Store → 2-rank training → Transformer."""
@@ -187,6 +188,7 @@ def test_early_stopping_callback_unit():
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # ~10s; fit/validation tests keep the estimator in tier-1
 def test_estimator_early_stopping(tmp_path):
     """Fit callbacks ride into the workers; EarlyStoppingCallback ends
     the fit on every rank together (history shorter than epochs)."""

@@ -6,8 +6,10 @@ the single command the verify recipe / CI calls; it exits nonzero on any
 unsuppressed finding (same contract as ``python -m horovod_tpu.analysis``
 and the ``hvdlint`` console script — see docs/static_analysis.md).
 ``--race`` passes through to the hvdrace lock-order/thread-lifecycle
-analysis (HVD2xx) and ``--mem`` to the hvdmem HBM donation analysis
-(HVD3xx), both with the identical exit-code contract.
+analysis (HVD2xx), ``--mem`` to the hvdmem HBM donation analysis
+(HVD3xx), and ``--comm`` to the hvdshard sharding/communication
+analysis (HVD4xx) — all with the identical exit-code contract;
+``--all`` runs every pass over one shared walk and exits with the max.
 """
 
 import os
