@@ -82,7 +82,21 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_SERVE_TIER_OVERSUB": "4.0",
                  "HVD_SERVE_TIER_QUANTUM": "8",
                  "HVD_SERVE_TIER_FETCH_TIMEOUT_S": "2.0",
-                 "HVD_SERVE_TIER_PUBLISH": "1"}
+                 "HVD_SERVE_TIER_PUBLISH": "1",
+                 "HVD_SERVE_DRAIN_S": "30",
+                 "HVD_ROUTE_AFFINITY_BLOCKS": "2",
+                 "HVD_ROUTE_VNODES": "64",
+                 "HVD_ROUTE_BOUNDED_LOAD": "2.0",
+                 "HVD_ROUTE_HEDGE_MS": "0",
+                 "HVD_ROUTE_RETRY_MAX": "3",
+                 "HVD_ROUTE_RETRY_BASE_MS": "10",
+                 "HVD_ROUTE_RETRY_CAP_MS": "2000",
+                 "HVD_ROUTE_EJECT_FAILURES": "3",
+                 "HVD_ROUTE_PROBE_S": "1.0",
+                 "HVD_ROUTE_HEALTH_S": "0",
+                 "HVD_ROUTE_CONNECT_TIMEOUT_S": "2.0",
+                 "HVD_ROUTE_DEFAULT_TIMEOUT_S": "30",
+                 "HVD_ROUTE_DRAIN_S": "30"}
 
 
 def _last_good_path():
@@ -1374,6 +1388,124 @@ def bench_serve():
         "migration_outputs_match": mig_first + mig_rest == mig_ref,
     }
 
+    # -- arm 11: hvdroute front door (ISSUE 18) -------------------------------
+    # Two single-replica serve endpoints behind the prefix-affinity
+    # router, repeat sessions driven through the real HTTP tier:
+    # affinity_hit_rate (did repeats land where their blocks live),
+    # zero_lost (every request answered, bit-identical to a single
+    # engine serving the same prompts), and the hedging sub-arm — a
+    # seeded slow-route fault train stalls one endpoint's forwards and
+    # the hedged pass must beat the unhedged pass's p99.
+    import http.client
+    from horovod_tpu.faultline import runtime as _flt
+    from horovod_tpu.faultline.plan import parse_plan
+    from horovod_tpu.serve import (Router, RouterConfig, RouterServer,
+                                   ServeServer)
+
+    route_backends = []
+    route_endpoints = []
+    for i in range(2):
+        bsched = build_replicas(
+            lambda: prefix_adapter, num_replicas=1,
+            metrics=ServeMetrics(), kv_mode="paged",
+            num_blocks=interf_blocks, prefill_chunk=chunk,
+            prefix_cache=True)
+        bsrv = ServeServer(bsched)
+        bport = bsrv.start(port=0, host="127.0.0.1")
+        route_backends.append(bsrv)
+        route_endpoints.append(f"127.0.0.1:{bport}")
+    router = Router(route_endpoints, config=RouterConfig())
+    rsrv = RouterServer(router)
+    rport = rsrv.start(port=0, host="127.0.0.1")
+
+    def route_post(payload):
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+        try:
+            conn.request("POST", "/generate",
+                         json.dumps(payload).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    route_sessions = 4 if smoke else 6
+    route_reps = 3
+    route_toks = 4
+    route_prompts = [[(17 * s + j) % 256 for j in range(12)]
+                     for s in range(route_sessions)]
+    route_lost = 0
+    route_outs = {}
+    for rep in range(route_reps):
+        for i, p in enumerate(route_prompts):
+            st, rbody = route_post({"tokens": p,
+                                    "max_new_tokens": route_toks})
+            if st != 200:
+                route_lost += 1
+            else:
+                route_outs.setdefault(i, set()).add(tuple(rbody["tokens"]))
+    route_ref_eng = InferenceEngine(prefix_adapter, max_batch=8,
+                                    kv_mode="paged",
+                                    num_blocks=interf_blocks,
+                                    prefill_chunk=chunk, prefix_cache=True,
+                                    metrics=ServeMetrics(),
+                                    replica_id="bench-route-ref").start()
+    route_ref = engine_storm(route_ref_eng, route_prompts, route_toks)
+    route_ref_eng.stop()
+    route_zero_lost = route_lost == 0 and all(
+        route_outs.get(i) == {tuple(route_ref[i])}
+        for i in range(route_sessions))
+    rsnap = router.metrics.snapshot()
+
+    # Hedging sub-arm: prompts whose affinity target is endpoint 0, a
+    # persistent slow-route stall on that endpoint, unhedged vs hedged.
+    hedge_prompts = []
+    s = 0
+    while len(hedge_prompts) < 4 and s < 4096:
+        p = [(31 * s + j) % 256 for j in range(12)]
+        if router._ring.lookup(router.affinity_key(p))[0] == \
+                route_endpoints[0]:
+            hedge_prompts.append(p)
+        s += 1
+    stall_s = 0.15 if smoke else 0.3
+    hedge_lat = {}
+    hsnaps = {}
+    for mode, hedge_ms in (("unhedged", 0.0), ("hedged", 30.0)):
+        hrouter = Router(route_endpoints,
+                         config=RouterConfig(hedge_s=hedge_ms / 1e3))
+        _flt.install(parse_plan(
+            f"slow-route:{route_endpoints[0]}@0*100000~{stall_s}"
+            f"/router.forward", seed=0))
+        lats = []
+        try:
+            for p in hedge_prompts:
+                t1 = time.perf_counter()
+                hrouter.handle(
+                    json.dumps({"tokens": p,
+                                "max_new_tokens": route_toks}).encode(),
+                    {}, None)
+                lats.append((time.perf_counter() - t1) * 1e3)
+        finally:
+            _flt.uninstall()
+        hedge_lat[mode] = sorted(lats)[-1]  # p99 ~= max at this n
+        hsnaps[mode] = hrouter.metrics.snapshot()
+    rsrv.stop()
+    for bsrv in route_backends:
+        bsrv.stop()
+    arm_router = {
+        "endpoints": len(route_endpoints),
+        "requests": route_sessions * route_reps,
+        "zero_lost": route_zero_lost,
+        "affinity_hit_rate": rsnap["affinity"]["hit_rate"],
+        "retries": rsnap["retries"],
+        "ejections": rsnap["ejections"],
+        "hedges": hsnaps["hedged"]["hedges"],
+        "hedges_won": hsnaps["hedged"]["hedges_won"],
+        "unhedged_p99_ms": round(hedge_lat["unhedged"], 3),
+        "hedged_p99_ms": round(hedge_lat["hedged"], 3),
+        "hedge_win": hedge_lat["hedged"] <= hedge_lat["unhedged"],
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -1411,6 +1543,7 @@ def bench_serve():
         "autoscale": arm_autoscale,
         "multitenant": arm_multitenant,
         "tiered": arm_tiered,
+        "router": arm_router,
     })
 
 

@@ -16,7 +16,9 @@ Vocabulary:
   decode step), ``pool-corrupt-block`` (a cached KV block's contents
   become suspect and must leave the prefix registry),
   ``delay-tier-fetch`` / ``drop-tier-block`` (tiered-KV prefetch /
-  migration transport flakes at the ``tier.fetch`` boundary);
+  migration transport flakes at the ``tier.fetch`` boundary),
+  ``drop-route`` / ``slow-route`` / ``blackhole-endpoint`` (front-door
+  forwarding flakes at the hvdroute ``router.forward`` boundary);
 * an **injection point** names a code location that consults the plan
   (``POINTS``): the serve engine's step boundary (``engine.step``), the
   scheduler's routing path (``replica.route``), the KV client's request
@@ -45,11 +47,12 @@ from typing import Dict, List, Optional, Tuple
 #: Fault kinds (docs/fault_injection.md has the per-kind semantics).
 KINDS = ("kill-rank", "delay-kv", "drop-kv-response", "poison-step",
          "slow-decode", "pool-corrupt-block", "load-spike", "swap-abort",
-         "delay-tier-fetch", "drop-tier-block")
+         "delay-tier-fetch", "drop-tier-block", "drop-route",
+         "slow-route", "blackhole-endpoint")
 
 #: Injection points threaded through the codebase.
 POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll",
-          "ctl.poll", "registry.roll", "tier.fetch")
+          "ctl.poll", "registry.roll", "tier.fetch", "router.forward")
 
 #: Default injection point per kind (a spec may override, e.g. kill-rank
 #: at replica.route fires report_rank_lost directly instead of going
@@ -79,6 +82,20 @@ DEFAULT_POINT = {
     # the engine degrades to recompute (bit-identical by construction).
     "delay-tier-fetch": "tier.fetch",
     "drop-tier-block": "tier.fetch",
+    # The hvdroute front door's forward boundary (serve/router.py):
+    # consulted once per forward ATTEMPT with the candidate endpoint as
+    # the instance — ``drop-route`` fails the attempt as a transport
+    # error (the router's retry/failover discipline absorbs it),
+    # ``slow-route`` stalls it by ``param`` seconds (the tail the hedging
+    # arm must beat), ``blackhole-endpoint`` makes the TARGET endpoint
+    # unreachable for ``param`` seconds (every attempt fails, half-open
+    # probes included — the ejection/readmission walk under test).
+    # ``kill-rank`` may be pointed here too (/router.forward): a rank
+    # loss DETECTED at the front door, acted out as immediate ejection
+    # of the target endpoint.
+    "drop-route": "router.forward",
+    "slow-route": "router.forward",
+    "blackhole-endpoint": "router.forward",
 }
 
 #: Step-assignment window for specs without an explicit ``@step``: drawn

@@ -30,6 +30,10 @@ Layers (docs/serving.md has the architecture):
   migration via the fleet block directory;
 * :mod:`server`  — HTTP ``/generate`` ``/healthz`` ``/metrics`` +
   ``hvdserve`` CLI;
+* :mod:`router` / :mod:`router_server` — hvdroute: the fault-tolerant
+  prefix-affinity front door over N serve endpoints (consistent-hash
+  affinity, deadline-bounded retries, tail hedging, ejection/half-open
+  readmission, graceful drain — docs/serving.md front door);
 * :mod:`metrics` — TTFT / per-token histograms, occupancy, tokens/s.
 
 Quickstart (CPU-exercisable end to end)::
@@ -77,7 +81,14 @@ from .registry import (  # noqa: F401
 from .replica import (  # noqa: F401
     NoHealthyReplicaError, Replica, ReplicaScheduler, build_replicas,
 )
-from .server import ServeServer, run_commandline  # noqa: F401
+from .router import (  # noqa: F401
+    Router, RouterConfig, RouterMetrics,
+)
+from .router_server import RouterServer  # noqa: F401
+from .server import (  # noqa: F401
+    DrainingThreadingHTTPServer, ServeServer, arm_signal_event,
+    run_commandline, serve_until_signal,
+)
 from .tenancy import (  # noqa: F401
     DeficitRoundRobin, TenantAccounting, TenantConfig, safe_tenant,
 )

@@ -61,6 +61,85 @@ from .metrics import ServeMetrics
 from .replica import NoHealthyReplicaError, ReplicaScheduler
 
 
+class DrainingThreadingHTTPServer(ThreadingHTTPServer):
+    """``ThreadingHTTPServer`` + graceful drain, shared by hvdserve and
+    hvdroute (docs/serving.md drain runbook).
+
+    ``stop()`` alone joins the ACCEPTOR but leaves handler threads
+    racing process exit — a SIGTERM mid-decode used to kill in-flight
+    requests with the connection open.  The drain contract instead:
+    ``begin_drain()`` flips ``draining`` (handlers refuse new work with
+    503 + ``Connection: close``), ``wait_idle()`` blocks until every
+    in-flight handler has written its response, and only then does the
+    owner tear the listener down.  In-flight accounting is the
+    handlers' job (``request_began``/``request_ended`` around the real
+    work) so a parked keep-alive connection with no active request
+    never holds the drain hostage."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def request_began(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+
+    def request_ended(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        return self._idle.wait(timeout)
+
+
+def arm_signal_event() -> threading.Event:
+    """Install SIGTERM/SIGINT handlers that set (and return) an event.
+    Called BEFORE the listener's readiness banner prints: a supervisor
+    that signals the moment it sees the banner must find the handlers
+    already armed, or the default handler races the process down
+    mid-startup (the gap :func:`serve_until_signal` alone leaves)."""
+    import signal
+
+    evt = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda signum, frame: evt.set())
+        except ValueError:  # pragma: no cover - not the main thread
+            break
+    return evt
+
+
+def serve_until_signal(drain_fn, evt: Optional[threading.Event] = None
+                       ) -> int:
+    """Foreground CLI discipline shared by hvdserve and hvdroute: park
+    until SIGTERM/SIGINT, then drain-then-exit 0.  SIGTERM used to hit
+    the default handler and race the process down mid-request; now both
+    signals set an event, the loop wakes, and ``drain_fn`` finishes
+    in-flight work before the listener closes.  Pass the event from an
+    earlier :func:`arm_signal_event` when signals must already be
+    handled during startup (the CLI paths do)."""
+    if evt is None:
+        evt = arm_signal_event()
+    try:
+        while not evt.wait(0.5):
+            pass
+    finally:
+        drain_fn()
+    return 0
+
+
 class _ServeHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     disable_nagle_algorithm = True  # see module doc / runner KV server
@@ -117,16 +196,33 @@ class _ServeHandler(BaseHTTPRequestHandler):
         hint = -(-depth * svc_s // max(healthy, 1))  # ceil division
         return max(1, min(int(hint), max(cap, 1)))
 
-    def _budget_headers(self, request) -> tuple:
+    def _header_budget_s(self) -> Optional[float]:
+        """The client budget visible at the HTTP layer alone: the
+        ``X-Request-Timeout-S`` header.  The shed sites that fire BEFORE
+        a Request exists (the drain refusal) must still clamp their
+        Retry-After by it — the load-aware hint could otherwise exceed
+        the client's whole budget and a compliant client would sleep its
+        deadline away (PR 12 clamped only the post-construction
+        sites)."""
+        raw = self.headers.get("X-Request-Timeout-S")
+        try:
+            budget = float(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            return None
+        return budget if budget is not None and budget > 0 else None
+
+    def _budget_headers(self, request=None) -> tuple:
         """503/504 shed headers (module doc).  ``Retry-After`` is the
         MINIMUM wait a compliant client honors, so it stays the server's
         availability hint (``_retry_after_s``) merely CAPPED by the
         client's remaining budget — advertising the full budget there
         would make a well-behaved client sleep its budget away and retry
         with nothing left.  The exact budget rides
-        X-Deadline-Remaining-S."""
+        X-Deadline-Remaining-S.  Without a Request (a shed before
+        construction), the header-level budget stands in."""
         hint = self._retry_after_s()
-        remaining = request.remaining()
+        remaining = (request.remaining() if request is not None
+                     else self._header_budget_s())
         if remaining is None:
             return (("Retry-After", str(hint)),)
         return (("Retry-After", str(min(hint, int(remaining)))),
@@ -156,6 +252,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             health = self.server.scheduler.healthz()
+            # Front-door signals (serve/router.py active health): the
+            # controller's brownout rung and the drain state ride the
+            # health answer so the router consumes the fleet's own
+            # verdict instead of re-deriving it from failures.
+            health["brownout_level"] = getattr(
+                self.server.metrics, "brownout_level", 0)
+            health["draining"] = bool(
+                getattr(self.server, "draining", False))
             code = 200 if health["status"] != "unserving" else 503
             self._reply_json(code, health)
         elif path == "/metrics":
@@ -176,6 +280,32 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": f"unknown path {path}"})
 
     def do_POST(self):
+        # Drain refusal (docs/serving.md runbook): a draining server
+        # finishes in-flight work but accepts none — refused with 503 +
+        # Connection: close so the client reconnects elsewhere, and
+        # Retry-After clamped by the HEADER budget (no Request exists
+        # yet at this shed site).
+        if getattr(self.server, "draining", False):
+            self._trace_ctx = None
+            self._trace_echo = self._safe_id(
+                self.headers.get("X-Trace-Id"))
+            self._shed_log("draining", None, "refused: draining")
+            self._reply_json(
+                503, {"error": "draining: server is shutting down"},
+                extra_headers=tuple(self._budget_headers())
+                + (("Connection", "close"),))
+            return
+        began = getattr(self.server, "request_began", None)
+        if began is not None:
+            began()
+        try:
+            self._do_post_inner()
+        finally:
+            ended = getattr(self.server, "request_ended", None)
+            if ended is not None:
+                ended()
+
+    def _do_post_inner(self):
         # Trace ingress (docs/observability.md): an inbound X-Trace-Id
         # continues the upstream hop's trace (it made the sampling
         # decision); otherwise HVD_TRACE_SAMPLE decides.  The context
@@ -381,8 +511,8 @@ class ServeServer:
         self.scheduler.start()
         if self.controller is not None:
             self.controller.start()
-        self.httpd = ThreadingHTTPServer((host, port), _ServeHandler)
-        self.httpd.daemon_threads = True
+        self.httpd = DrainingThreadingHTTPServer((host, port),
+                                                 _ServeHandler)
         self.httpd.scheduler = self.scheduler
         self.httpd.metrics = self.metrics
         self.httpd.registry = self.registry
@@ -405,6 +535,27 @@ class ServeServer:
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
+
+    def drain(self, grace_s: Optional[float] = None) -> bool:
+        """Graceful shutdown (docs/serving.md drain runbook): refuse new
+        requests (503 + ``Connection: close``), wait up to ``grace_s``
+        (``HVD_SERVE_DRAIN_S``) for in-flight handlers to finish, then
+        :meth:`stop`.  Returns True when the drain completed inside the
+        grace window (the SIGTERM path exits 0 either way — a hung
+        handler must not wedge the shutdown, but it is reported)."""
+        if grace_s is None:
+            grace_s = float(os.environ.get("HVD_SERVE_DRAIN_S", "30"))
+        httpd = self.httpd
+        drained = True
+        if httpd is not None:
+            httpd.begin_drain()
+            drained = httpd.wait_idle(timeout=grace_s)
+            if not drained:
+                get_logger().warning(
+                    "hvdserve: drain grace (%.1fs) expired with "
+                    "requests still in flight", grace_s)
+        self.stop()
+        return bool(drained)
 
     def stop(self) -> None:
         if self.httpd is not None:
@@ -470,7 +621,6 @@ def _build_adapter_factory(args):
 
 def run_commandline(argv=None) -> int:
     import argparse
-    import time
 
     parser = argparse.ArgumentParser(
         prog="hvdserve",
@@ -523,14 +673,13 @@ def run_commandline(argv=None) -> int:
         from .controller import FleetController
         controller = FleetController(scheduler)
     server = ServeServer(scheduler, controller=controller)
+    # Arm the drain signals BEFORE the readiness banner: a supervisor
+    # may SIGTERM the instant it sees the banner.
+    evt = arm_signal_event()
     port = server.start(port=args.port)
     print(f"hvdserve: listening on :{port} — POST /generate, GET /healthz, "
           f"GET /metrics", flush=True)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        pass
-    finally:
-        server.stop()
-    return 0
+    # SIGTERM/SIGINT → drain-then-exit 0 (docs/serving.md runbook):
+    # in-flight requests finish, new ones are refused with Connection:
+    # close, and only then does the listener close.
+    return serve_until_signal(server.drain, evt)

@@ -72,6 +72,14 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_TIER_OVERSUB", "HVD_SERVE_TIER_QUANTUM",
                 "HVD_SERVE_TIER_FETCH_TIMEOUT_S",
                 "HVD_SERVE_TIER_PUBLISH",
+                "HVD_SERVE_DRAIN_S", "HVD_ROUTE_AFFINITY_BLOCKS",
+                "HVD_ROUTE_VNODES", "HVD_ROUTE_BOUNDED_LOAD",
+                "HVD_ROUTE_HEDGE_MS", "HVD_ROUTE_RETRY_MAX",
+                "HVD_ROUTE_RETRY_BASE_MS", "HVD_ROUTE_RETRY_CAP_MS",
+                "HVD_ROUTE_EJECT_FAILURES", "HVD_ROUTE_PROBE_S",
+                "HVD_ROUTE_HEALTH_S", "HVD_ROUTE_CONNECT_TIMEOUT_S",
+                "HVD_ROUTE_DEFAULT_TIMEOUT_S", "HVD_ROUTE_DRAIN_S",
+                "HVD_ROUTE_ENDPOINTS", "HVD_ROUTE_PORT",
                 "HVD_FAULTLINE_SEED", "HVD_FAULTLINE_PLAN",
                 "HVD_KV_RETRY_MAX", "HVD_KV_RETRY_BASE_MS",
                 "HVD_KV_RETRY_CAP_MS", "HVD_SANITIZE", "HVD_RACE_RAISE",
@@ -364,6 +372,23 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert tiered["migration_failures"] == 0
         assert tiered["migrated_tokens"] > 0
         assert tiered["migrated_hit_tokens"] >= last["prefix"]["hit_tokens"]
+        # ISSUE 18: the router arm — the hvdroute front door in front of
+        # a 2-endpoint fleet keeps the zero-lost contract (every routed
+        # response bit-identical to the single-engine reference), keeps
+        # prefix affinity, and the hedged sub-arm's tail beats the
+        # seeded slow-route train it raced.
+        route = last["router"]
+        for key in ("endpoints", "requests", "zero_lost",
+                    "affinity_hit_rate", "retries", "ejections",
+                    "hedges", "hedges_won", "unhedged_p99_ms",
+                    "hedged_p99_ms", "hedge_win"):
+            assert key in route, f"router.{key} missing: {route}"
+        assert route["zero_lost"] is True  # routed ≡ reference, exact
+        assert route["endpoints"] >= 2
+        assert route["requests"] >= 8
+        assert 0 <= route["affinity_hit_rate"] <= 1
+        assert route["hedges"] >= 1        # the hedge arm really raced
+        assert route["hedge_win"] is True  # hedged p99 <= unhedged p99
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:

@@ -50,10 +50,13 @@ def test_lint_covers_the_whole_tree():
     # autoscale/brownout decision loop must stay under the same lint.
     # tenancy.py / registry.py (ISSUE 15) carry the fairness scheduler
     # and the hot-swap walk — same deal.
+    # router.py / router_server.py (ISSUE 18) carry the front-door
+    # retry/hedge/health machinery — same deal.
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
                 "server.py", "metrics.py", "paged_attention.py",
                 "sampling.py", "controller.py", "tenancy.py",
-                "registry.py", "tiering.py"):
+                "registry.py", "tiering.py", "router.py",
+                "router_server.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under

@@ -86,6 +86,11 @@ def test_race_walk_covers_the_threaded_tree():
                for f in files), "serve/tenancy.py not analyzed"
     assert any(f.endswith(os.path.join("serve", "tiering.py"))
                for f in files), "serve/tiering.py not analyzed"
+    # The hvdroute front door (ISSUE 18) runs forwards, hedges, and the
+    # active health poller on their own threads over the router lock.
+    for mod in ("router.py", "router_server.py"):
+        assert any(f.endswith(os.path.join("serve", mod))
+                   for f in files), f"serve/{mod} not analyzed"
     # The hvdshard analyzer (ISSUE 17) is lock-free by design (pure AST
     # + jaxpr walks) — checked only if the walker visits it.
     assert any(f.endswith(os.path.join("analysis", "shardplan.py"))
@@ -105,7 +110,8 @@ def test_race_walk_covers_the_threaded_tree():
                   "BlockManager._lock", "ElasticDriver._lock",
                   "Negotiator._buf_lock", "Negotiator._flush_lock",
                   "Tracer._lock", "FleetController._lock",
-                  "ModelRegistry._lock", "TieredBlockManager._lock"):
+                  "ModelRegistry._lock", "TieredBlockManager._lock",
+                  "Router._lock", "RouterMetrics._lock"):
         assert label in analyzer.lock_sites, \
             f"{label} missing from the witness registry"
     # Condition-wraps-lock aliasing: the batcher's _cond must NOT appear

@@ -1,0 +1,251 @@
+"""ISSUE 18 soak: hvdroute in front of a real 4-endpoint fleet under a
+kill + roll storm — the tentpole's acceptance run.
+
+* zero lost requests: every session request answers 200 across an
+  endpoint's HTTP listener dying mid-storm (plus a ``kill-rank`` at
+  ``router.forward``) and a live ``registry.roll`` on another endpoint,
+  and every answer is bit-identical to the single-served reference;
+* affinity: repeat sessions keep landing on the endpoint that already
+  served them — hit rate stays far above the uniform-routing floor even
+  though one endpoint's sessions were forcibly remapped;
+* hedging: with a ``slow-route`` fault stalling one endpoint, the
+  hedged router's p99 beats (or ties) the unhedged router's;
+* drain: ``python -m horovod_tpu.serve.router`` under SIGTERM drains
+  and exits 0 — the front-door runbook contract.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu.faultline as fl
+from horovod_tpu.models import create_mlp
+from horovod_tpu.serve import (MLPAdapter, ModelRegistry, Router,
+                               RouterConfig, RouterServer, ServeMetrics,
+                               ServeServer, build_replicas)
+
+pytestmark = pytest.mark.slow
+
+VOCAB = 31
+TOKS = 6
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fl.uninstall()
+    yield
+    fl.uninstall()
+
+
+def _params(seed=3):
+    mlp = create_mlp(features=(16, VOCAB))
+    return mlp, mlp.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, VOCAB), np.float32))["params"]
+
+
+def _mlp_chain(adapter, prompt, n):
+    seq = []
+    tok = prompt[-1]
+    for _ in range(n):
+        tok = int(adapter._apply(np.asarray([tok], np.int32))[0])
+        seq.append(tok)
+    return seq
+
+
+def _fleet(n, mlp, params):
+    """n single-replica serve endpoints sharing the same weights (so
+    every endpoint answers every prompt identically — the router may
+    land a session anywhere without changing its output)."""
+    servers, endpoints = [], []
+    for _ in range(n):
+        adapter = MLPAdapter(mlp, params, vocab_size=VOCAB, max_len=128)
+        sched = build_replicas(lambda: adapter, num_replicas=1,
+                               metrics=ServeMetrics())
+        srv = ServeServer(sched)
+        port = srv.start(port=0, host="127.0.0.1")
+        servers.append(srv)
+        endpoints.append(f"127.0.0.1:{port}")
+    return servers, endpoints
+
+
+def _post(port, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Timeout-S": "30"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_router_soak_zero_lost_under_kill_and_roll():
+    mlp, params = _params()
+    servers, endpoints = _fleet(4, mlp, params)
+    config = RouterConfig(retry_base_s=0.005, retry_cap_s=0.05,
+                          eject_failures=2, probe_s=0.2)
+    router = Router(endpoints, config=config)
+    rsrv = RouterServer(router)
+    rport = rsrv.start(port=0, host="127.0.0.1")
+
+    rng = np.random.RandomState(0)
+    sessions = [rng.randint(0, VOCAB, size=(int(rng.randint(6, 14)),)
+                            ).tolist() for _ in range(10)]
+    results = []  # (session, status, tokens)
+    results_lock = threading.Lock()
+
+    def storm(reps, workers=4):
+        work = [(i, p) for _ in range(reps)
+                for i, p in enumerate(sessions)]
+        chunk = (len(work) + workers - 1) // workers
+
+        def run(items):
+            for i, p in items:
+                st, body = _post(rport,
+                                 {"tokens": p, "max_new_tokens": TOKS})
+                with results_lock:
+                    results.append((i, st, tuple(body.get("tokens", ()))))
+
+        threads = [threading.Thread(
+            target=run, args=(work[k * chunk:(k + 1) * chunk],),
+            daemon=True) for k in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "storm worker wedged"
+
+    try:
+        # Phase A: clean fleet — sessions pin to their affinity targets.
+        storm(reps=3)
+        # Chaos: kill one endpoint's HTTP LISTENER only (its engine
+        # lives on, as a real preemption looks from the router's seat),
+        # declare the loss at router.forward too, and roll another
+        # endpoint's weights live mid-storm.
+        victim = router._ring.lookup(router.affinity_key(sessions[0]))[0]
+        victim_srv = servers[endpoints.index(victim)]
+        victim_srv.httpd.shutdown()
+        victim_srv.httpd.server_close()
+        fl.install(fl.parse_plan(f"kill-rank:{victim}@0*1/router.forward"))
+        roll_srv = next(s for e, s in zip(endpoints, servers)
+                        if e != victim)
+        reg = ModelRegistry(roll_srv.scheduler)
+        reg.adopt("default")
+        roller = threading.Thread(
+            target=lambda: reg.roll(
+                "default",
+                adapter=MLPAdapter(mlp, params, vocab_size=VOCAB,
+                                   max_len=128)),
+            daemon=True)
+        roller.start()
+        # Phase B: the same sessions through the degraded fleet.
+        storm(reps=3)
+        roller.join(timeout=60)
+        assert not roller.is_alive(), "roll wedged mid-storm"
+    finally:
+        fl.uninstall()
+        rsrv.stop()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:
+                pass  # the victim's listener is already down
+
+    # Zero lost: every request across both phases answered 200 with the
+    # single-served reference output, bit-identical.
+    assert len(results) == 10 * 6
+    assert all(st == 200 for _, st, _ in results)
+    ref_adapter = MLPAdapter(mlp, params, vocab_size=VOCAB, max_len=128)
+    for i, p in enumerate(sessions):
+        expect = tuple(_mlp_chain(ref_adapter, p, TOKS))
+        got = {out for j, _, out in results if j == i}
+        assert got == {expect}, f"session {i} diverged: {got} != {expect}"
+
+    # Affinity: at most the victim's sessions were remapped, so the hit
+    # rate stays far above the 1/4 uniform-routing floor.
+    snap = router.metrics.snapshot()
+    assert snap["affinity"]["hit_rate"] >= 0.5
+    assert snap["ejections"] >= 1  # the kill was observed and acted on
+    assert snap["requests"]["ok"] == 60
+    assert snap["requests"].get("error", 0) == 0
+
+
+def test_router_soak_hedged_p99_beats_unhedged():
+    mlp, params = _params()
+    servers, endpoints = _fleet(2, mlp, params)
+    stall = 0.25
+    lat = {}
+    try:
+        probe = Router(endpoints, config=RouterConfig())
+        prompts = []
+        s = 0
+        while len(prompts) < 6 and s < 4096:
+            p = [(13 * s + j) % VOCAB for j in range(10)]
+            if probe._ring.lookup(probe.affinity_key(p))[0] == endpoints[0]:
+                prompts.append(p)
+            s += 1
+        assert len(prompts) == 6
+        for mode, hedge_s in (("unhedged", 0.0), ("hedged", 0.03)):
+            router = Router(endpoints,
+                            config=RouterConfig(hedge_s=hedge_s))
+            fl.install(fl.parse_plan(
+                f"slow-route:{endpoints[0]}@0*100000~{stall}"
+                f"/router.forward"))
+            samples = []
+            try:
+                for p in prompts:
+                    t0 = time.perf_counter()
+                    status, _, _ = router.handle(
+                        json.dumps({"tokens": p,
+                                    "max_new_tokens": TOKS}).encode(), {})
+                    samples.append(time.perf_counter() - t0)
+                    assert status == 200
+            finally:
+                fl.uninstall()
+            lat[mode] = sorted(samples)[-1]  # p99 == max at n=6
+    finally:
+        for srv in servers:
+            srv.stop()
+    # Every prompt's affinity target is the stalled endpoint: unhedged
+    # requests eat the stall, hedged ones race the second endpoint.
+    assert lat["unhedged"] >= stall
+    assert lat["hedged"] <= lat["unhedged"]
+
+
+def test_hvdroute_sigterm_drains_and_exits_zero():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", HVD_ROUTE_DRAIN_S="10")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.serve.router",
+         "--endpoints", "127.0.0.1:9", "--port", "0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        banner = {}
+
+        def read_banner():
+            banner["line"] = proc.stdout.readline()
+
+        t = threading.Thread(target=read_banner, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert banner.get("line", "").startswith(
+            "hvdroute: listening on :"), banner
+        port = int(banner["line"].split(":")[2].split()[0])
+        # The front door is actually serving before the signal.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            assert resp.status == 200
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        # Drain-then-exit-0: the runbook contract (no 5xx, no crash).
+        assert rc == 0, proc.stderr.read()[-2000:]
+    finally:
+        proc.kill()
