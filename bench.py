@@ -96,7 +96,11 @@ KNOB_DEFAULTS = {"BENCH_BERT_BATCH": "32", "BENCH_BERT_ATTN": "auto",
                  "HVD_ROUTE_HEALTH_S": "0",
                  "HVD_ROUTE_CONNECT_TIMEOUT_S": "2.0",
                  "HVD_ROUTE_DEFAULT_TIMEOUT_S": "30",
-                 "HVD_ROUTE_DRAIN_S": "30"}
+                 "HVD_ROUTE_DRAIN_S": "30",
+                 "HVD_SERVE_STREAM_QUEUE": "64",
+                 "HVD_SERVE_CTL_TTFT_SLO_MS": "0",
+                 "BENCH_SERVE_STREAM_SESSIONS": "6",
+                 "BENCH_SERVE_STREAM_TEMP": "0.8"}
 
 
 def _last_good_path():
@@ -419,7 +423,13 @@ def bench_serve():
       storm (fixed per-request seeds) run twice must produce identical
       outputs, and an n=4 CoW-forked n-best request's peak pool bytes
       must sit strictly below 4x the n=1 footprint (prompt blocks
-      shared through the BlockManager's copy-on-write tables)."""
+      shared through the BlockManager's copy-on-write tables);
+    * ``stream``   — token streaming (ISSUE 19): the same prompts
+      buffered then streamed over SSE — streamed-concat == buffered is
+      hard, client-perceived TTFT p50/p99 vs the buffered wait,
+      inter-token p99, a mid-stream hangup must free every KV block,
+      and grammar-constrained sampled completions must be 100%
+      schema-valid."""
     import threading
     from horovod_tpu.models.transformer import (Transformer,
                                                 TransformerConfig)
@@ -1506,6 +1516,171 @@ def bench_serve():
         "hedge_win": hedge_lat["hedged"] <= hedge_lat["unhedged"],
     }
 
+    # -- arm 12: hvdstream token streaming (ISSUE 19) -------------------------
+    # One serve endpoint driven through the real HTTP tier, the same
+    # prompts buffered then streamed: streamed-concat == buffered is
+    # HARD (bit-exactness through the SSE path), client-perceived TTFT
+    # (first token event vs the buffered full-response wait — the whole
+    # point of streaming), inter-token p99, a mid-stream client
+    # disconnect must free every KV block, and the structured sub-arm
+    # must emit 100% schema-valid completions at temperature > 0.
+    stream_sessions = int(os.environ.get(
+        "BENCH_SERVE_STREAM_SESSIONS",
+        KNOB_DEFAULTS["BENCH_SERVE_STREAM_SESSIONS"]))
+    stream_temp = float(os.environ.get(
+        "BENCH_SERVE_STREAM_TEMP",
+        KNOB_DEFAULTS["BENCH_SERVE_STREAM_TEMP"]))
+    if smoke:
+        stream_sessions = min(stream_sessions, 3)
+    stream_toks = min(new_tokens, 16)
+    stream_sched = build_replicas(
+        lambda: prefix_adapter, num_replicas=1, metrics=ServeMetrics(),
+        kv_mode="paged", num_blocks=interf_blocks, prefill_chunk=chunk,
+        prefix_cache=True)
+    stream_srv = ServeServer(stream_sched)
+    stream_port = stream_srv.start(port=0, host="127.0.0.1")
+    stream_prompts = [[(13 * s + j) % 256 for j in range(10)]
+                      for s in range(stream_sessions)]
+
+    def buffered_post(payload):
+        conn = http.client.HTTPConnection("127.0.0.1", stream_port,
+                                          timeout=120)
+        try:
+            conn.request("POST", "/generate", json.dumps(payload).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def stream_post(payload, hangup_after=None):
+        """POST with ``stream: true``; returns (events, first-token
+        latency ms, inter-token gaps ms).  ``hangup_after=n`` closes
+        the socket after the nth token event (the client-gone arm)."""
+        from horovod_tpu.serve.streaming import parse_sse
+        conn = http.client.HTTPConnection("127.0.0.1", stream_port,
+                                          timeout=120)
+        t1 = time.perf_counter()
+        conn.request("POST", "/generate",
+                     json.dumps(dict(payload, stream=True)).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raw = resp.read()
+            conn.close()
+            return [("error", json.loads(raw))], None, []
+        buf = b""
+        seen = 0
+        ttft_ms = None
+        gaps = []
+        last_t = None
+        try:
+            while True:
+                data = resp.read1(8192)
+                if not data:
+                    break
+                buf += data
+                n_tok = sum(1 for e in parse_sse(buf)
+                            if e[0] == "token")
+                if n_tok > seen:
+                    now_t = time.perf_counter()
+                    if ttft_ms is None:
+                        ttft_ms = (now_t - t1) * 1e3
+                    if last_t is not None:
+                        gaps.append((now_t - last_t) * 1e3)
+                    last_t = now_t
+                    seen = n_tok
+                    if hangup_after is not None and seen >= hangup_after:
+                        return parse_sse(buf), ttft_ms, gaps
+        finally:
+            conn.close()
+        return parse_sse(buf), ttft_ms, gaps
+
+    buffered_lat = []
+    buffered_toks = []
+    for p in stream_prompts:
+        t1 = time.perf_counter()
+        st, rbody = buffered_post({"tokens": p,
+                                   "max_new_tokens": stream_toks})
+        buffered_lat.append((time.perf_counter() - t1) * 1e3)
+        buffered_toks.append(rbody["tokens"] if st == 200 else None)
+    stream_match = True
+    stream_ttft = []
+    stream_gaps = []
+    for i, p in enumerate(stream_prompts):
+        events, ttft_ms, gaps = stream_post(
+            {"tokens": p, "max_new_tokens": stream_toks})
+        toks = [t for e in events if e[0] == "token"
+                for t in e[1]["tokens"]]
+        if toks != buffered_toks[i]:
+            stream_match = False
+        stream_ttft.append(ttft_ms)
+        stream_gaps.extend(gaps)
+
+    def _pctl(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)], 3)
+
+    # Client-gone sub-arm: hang up mid-stream, the engine must reap the
+    # sequence and hand back every block.
+    stream_post({"tokens": stream_prompts[0],
+                 "max_new_tokens": max(stream_toks, 8)}, hangup_after=1)
+    stream_eng = stream_sched.replicas[0].engine
+    gone_deadline = time.monotonic() + 30
+    kv_used = -1
+    while time.monotonic() < gone_deadline:
+        kv_used = stream_eng.kv_stats()["used"]
+        if kv_used == 0:
+            break
+        time.sleep(0.02)
+    gone_count = stream_eng.metrics.snapshot()["requests"].get(
+        "client_gone", 0)
+
+    # Structured sub-arm: sampled (temperature > 0) generation under a
+    # JSON-Schema grammar — every completion must parse AND validate.
+    stream_schema = {"type": "object",
+                     "properties": {"ok": {"type": "boolean"}},
+                     "required": ["ok"]}
+    schema_valid = 0
+    schema_total = stream_sessions
+    for i, p in enumerate(stream_prompts):
+        st, rbody = buffered_post(
+            {"tokens": p, "max_new_tokens": 24, "schema": stream_schema,
+             "eos_id": 0, "temperature": stream_temp, "seed": 1000 + i})
+        if st != 200:
+            continue
+        toks = rbody["tokens"]
+        if toks and toks[-1] == 0:
+            toks = toks[:-1]
+        try:
+            doc = json.loads(bytes(toks).decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("ok"), bool) \
+                and set(doc) <= {"ok"}:
+            schema_valid += 1
+    stream_srv.stop()
+    arm_stream = {
+        "sessions": stream_sessions,
+        "new_tokens": stream_toks,
+        "outputs_match": stream_match,
+        "buffered_p50_ms": _pctl(buffered_lat, 0.5),
+        "buffered_p99_ms": _pctl(buffered_lat, 0.99),
+        "ttft_p50_ms": _pctl(stream_ttft, 0.5),
+        "ttft_p99_ms": _pctl(stream_ttft, 0.99),
+        "intertoken_p99_ms": _pctl(stream_gaps, 0.99),
+        "ttft_win": (_pctl(stream_ttft, 0.5) or 1e9)
+        < (_pctl(buffered_lat, 0.5) or 0),
+        "client_gone_kv_used": kv_used,
+        "client_gone_counted": gone_count,
+        "schema_valid": schema_valid,
+        "schema_total": schema_total,
+        "schema_valid_rate": round(schema_valid / max(schema_total, 1),
+                                   3),
+    }
+
     _emit({
         "metric": "serve_tokens_per_sec",
         "value": round(total_tokens / dt, 2),
@@ -1544,6 +1719,7 @@ def bench_serve():
         "multitenant": arm_multitenant,
         "tiered": arm_tiered,
         "router": arm_router,
+        "stream": arm_stream,
     })
 
 

@@ -18,7 +18,9 @@ Vocabulary:
   ``delay-tier-fetch`` / ``drop-tier-block`` (tiered-KV prefetch /
   migration transport flakes at the ``tier.fetch`` boundary),
   ``drop-route`` / ``slow-route`` / ``blackhole-endpoint`` (front-door
-  forwarding flakes at the hvdroute ``router.forward`` boundary);
+  forwarding flakes at the hvdroute ``router.forward`` boundary),
+  ``stream-disconnect`` / ``slow-client`` (a streaming client hanging
+  up or stalling at the ``stream.emit`` write boundary);
 * an **injection point** names a code location that consults the plan
   (``POINTS``): the serve engine's step boundary (``engine.step``), the
   scheduler's routing path (``replica.route``), the KV client's request
@@ -48,11 +50,13 @@ from typing import Dict, List, Optional, Tuple
 KINDS = ("kill-rank", "delay-kv", "drop-kv-response", "poison-step",
          "slow-decode", "pool-corrupt-block", "load-spike", "swap-abort",
          "delay-tier-fetch", "drop-tier-block", "drop-route",
-         "slow-route", "blackhole-endpoint")
+         "slow-route", "blackhole-endpoint", "stream-disconnect",
+         "slow-client")
 
 #: Injection points threaded through the codebase.
 POINTS = ("engine.step", "replica.route", "kv.request", "preempt.poll",
-          "ctl.poll", "registry.roll", "tier.fetch", "router.forward")
+          "ctl.poll", "registry.roll", "tier.fetch", "router.forward",
+          "stream.emit")
 
 #: Default injection point per kind (a spec may override, e.g. kill-rank
 #: at replica.route fires report_rank_lost directly instead of going
@@ -96,6 +100,16 @@ DEFAULT_POINT = {
     "drop-route": "router.forward",
     "slow-route": "router.forward",
     "blackhole-endpoint": "router.forward",
+    # The streamed-response write boundary (serve/server.py
+    # _write_stream_frame): consulted once per SSE frame with the
+    # request id as the instance — ``stream-disconnect`` acts out the
+    # client hanging up mid-stream (a BrokenPipeError exactly where a
+    # real hangup surfaces, so the abort-frees-blocks walk is the REAL
+    # one), ``slow-client`` stalls the write by ``param`` seconds (the
+    # slow consumer the bounded token queue must absorb by coalescing,
+    # never by dropping).
+    "stream-disconnect": "stream.emit",
+    "slow-client": "stream.emit",
 }
 
 #: Step-assignment window for specs without an explicit ``@step``: drawn

@@ -97,10 +97,40 @@ class Request:
                  seed: Optional[int] = None,
                  qos: str = "latency",
                  tenant: str = TENANT_DEFAULT,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 stream: bool = False,
+                 logprobs: Optional[int] = None,
+                 schema=None):
         from .sampling import validate_params
         (self.temperature, self.top_k, self.top_p, self.n,
          self.seed) = validate_params(temperature, top_k, top_p, n, seed)
+        # hvdstream interactive-API fields (serve/streaming.py,
+        # serve/structured.py): ``stream`` opts the response into SSE
+        # token events, ``logprobs`` asks for top-k alternatives per
+        # generated token, ``schema`` constrains decoding to a
+        # JSON-Schema subset.  All three are n==1 features — the fork
+        # path has no per-sample sink/mask plumbing, and a silent
+        # single-sample downgrade would be worse than a 400.
+        if not isinstance(stream, bool):
+            raise ValueError(f"stream must be a boolean, got {stream!r}")
+        self.stream = stream
+        if logprobs is not None:
+            if isinstance(logprobs, bool) or not isinstance(logprobs, int):
+                raise ValueError(
+                    f"logprobs must be an integer, got {logprobs!r}")
+            if not 0 < logprobs <= 16:
+                raise ValueError(
+                    f"logprobs must be in [1, 16], got {logprobs}")
+        self.logprobs = logprobs
+        if schema is not None and not isinstance(schema, dict):
+            raise ValueError(
+                f"schema must be a JSON object, got "
+                f"{type(schema).__name__}")
+        self.schema = schema
+        if self.n > 1 and (stream or logprobs is not None
+                           or schema is not None):
+            raise ValueError(
+                "stream/logprobs/schema require n == 1")
         # Multi-tenant identity + model variant (serve/tenancy.py,
         # serve/registry.py): both share the tenant alphabet discipline
         # — they become Prometheus labels and routing keys, so a hostile
@@ -180,6 +210,24 @@ class Request:
                                            "decode": 0.0, "spec": 0.0,
                                            "retry": 0.0}
         self._stage_mark = self.submitted_at
+        # hvdstream runtime state: ``sink`` is the per-request
+        # TokenStream the engine publishes into (serve/streaming.py;
+        # None for buffered requests), ``grammar`` the compiled
+        # TokenGrammar the engine attaches at admission,
+        # ``token_logprobs`` the per-token logprob records when
+        # ``logprobs`` was requested, ``finish_reason`` the terminal
+        # cause ("stop" | "length" | "grammar").  ``cancelled`` is the
+        # client-disconnect flag: the HTTP handler sets it at write
+        # time (cancel()), the engine reaps the sequence at its next
+        # step — slot freed, paged blocks released, the outcome
+        # counted under ``cancel_reason``.
+        self.sink = None
+        self.grammar = None
+        self.token_logprobs: Optional[List] = (
+            [] if logprobs is not None else None)
+        self.finish_reason: Optional[str] = None
+        self.cancelled = False
+        self.cancel_reason: Optional[str] = None
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
 
@@ -205,11 +253,33 @@ class Request:
             return None
         return max(self.deadline - (now or time.monotonic()), 0.0)
 
+    def cancel(self, reason: str = "client_gone") -> None:
+        """Client-disconnect signal (hvdstream): flag only — the engine
+        observes it at its next step and reaps the sequence (blocks
+        freed, slot cleared, outcome counted under ``reason``).  Safe
+        from any thread; idempotent."""
+        self.cancelled = True
+        if self.cancel_reason is None:
+            self.cancel_reason = reason
+
     def complete(self) -> None:
+        # Terminal-event contract (serve/streaming.py module doc):
+        # wiring the sink HERE — not at the engine's call sites — means
+        # every completion path, present and future, lands a terminal
+        # event in the stream.  finish() also flushes any unpublished
+        # tail of ``generated``, making concatenated-stream ==
+        # buffered-response a hard invariant.
+        if self.sink is not None:
+            self.sink.finish(self.generated, self.token_logprobs)
         self._done.set()
 
     def fail(self, exc: BaseException) -> None:
         self._error = exc
+        if self.sink is not None:
+            # Mid-stream deadline expiry, brownout shed, failed
+            # failover, engine error: one terminal error event, never
+            # a silent hangup.
+            self.sink.abort(exc)
         self._done.set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -384,7 +454,11 @@ class DynamicBatcher:
         # queue-depth sampling (AB/BA deadlock).
         kept = []
         for r in self._queue:
-            (expired if r.expired(now) else kept).append(r)
+            # Cancelled (client-gone) requests leave with the expired
+            # set — same remove-here / fail-outside-the-lock discipline,
+            # distinguished at fail time.
+            (expired if r.expired(now) or r.cancelled
+             else kept).append(r)
         self._queue = kept
 
     def _take(self, free_slots: int, budget: Optional[int], cost,
@@ -499,6 +573,14 @@ class DynamicBatcher:
         finally:
             # Lock released (the with-block exits before finally runs).
             for r in expired:
+                if r.cancelled and not r.expired():
+                    # Client vanished while queued: nobody is listening
+                    # for this failure — the outcome label is the point.
+                    r.fail(QueueFullError(
+                        f"{r.request_id} client disconnected in queue"))
+                    if self._on_shed:
+                        self._on_shed(r, r.cancel_reason or "client_gone")
+                    continue
                 r.fail(DeadlineExceededError(
                     f"{r.request_id} expired after "
                     f"{time.monotonic() - r.submitted_at:.3f}s in queue"))

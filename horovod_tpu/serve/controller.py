@@ -100,6 +100,7 @@ class ControllerConfig:
     queue_high: float = 8.0        # per-healthy-replica queued requests
     queue_low: float = 1.0         # below this (and no pressure) = idle
     slo_ms: float = 0.0            # latency-tier p99 SLO; 0 disables
+    ttft_slo_ms: float = 0.0       # windowed TTFT p99 SLO; 0 disables
     headroom_min_bytes: int = 0    # kv_headroom floor; 0 disables
     up_polls: int = 3              # consecutive pressure polls to grow
     down_polls: int = 6            # consecutive idle polls to shrink
@@ -119,6 +120,7 @@ class ControllerConfig:
             queue_high=float(e("HVD_SERVE_CTL_QUEUE_HIGH", "8")),
             queue_low=float(e("HVD_SERVE_CTL_QUEUE_LOW", "1")),
             slo_ms=float(e("HVD_SERVE_CTL_SLO_MS", "0")),
+            ttft_slo_ms=float(e("HVD_SERVE_CTL_TTFT_SLO_MS", "0")),
             headroom_min_bytes=int(
                 e("HVD_SERVE_CTL_HEADROOM_MIN_BYTES", "0")),
             up_polls=int(e("HVD_SERVE_CTL_UP_POLLS", "3")),
@@ -156,6 +158,7 @@ class FleetSnapshot:
     queued: int                  # total queued across healthy replicas
     active: int = 0              # total in-flight sequences
     latency_p99_ms: Optional[float] = None  # windowed latency-tier p99
+    ttft_p99_ms: Optional[float] = None     # windowed TTFT p99
     kv_headroom_bytes: Optional[int] = None  # min across replicas
 
     def per_replica_queue(self) -> float:
@@ -182,6 +185,13 @@ def _pressure(cfg: ControllerConfig, snap: FleetSnapshot) -> bool:
         return True
     if (cfg.slo_ms > 0 and snap.latency_p99_ms is not None
             and snap.latency_p99_ms >= cfg.slo_ms):
+        return True
+    # Interactive/streamed clients feel time-to-first-token, not
+    # end-to-end latency — a fleet can hold the request-latency SLO
+    # while prefill queueing wrecks every stream's opening beat, so
+    # TTFT gets its own (env-gated, default-off) windowed-p99 term.
+    if (cfg.ttft_slo_ms > 0 and snap.ttft_p99_ms is not None
+            and snap.ttft_p99_ms >= cfg.ttft_slo_ms):
         return True
     if (cfg.headroom_min_bytes > 0 and snap.kv_headroom_bytes is not None
             and snap.kv_headroom_bytes < cfg.headroom_min_bytes):
@@ -312,6 +322,8 @@ class FleetController:
         self._brownout_since: Optional[float] = None
         self._prev_counts: Optional[List[int]] = None
         self._prev_total = 0
+        self._prev_ttft_counts: Optional[List[int]] = None
+        self._prev_ttft_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -429,10 +441,17 @@ class FleetController:
                            self._prev_total, total)
         self._prev_counts = counts
         self._prev_total = total
+        ttft_p99 = None
+        if self.cfg.ttft_slo_ms > 0:
+            tb, tc, tt = self.metrics.ttft_window()
+            ttft_p99 = windowed_p99(tb, self._prev_ttft_counts, tc,
+                                    self._prev_ttft_total, tt)
+            self._prev_ttft_counts = tc
+            self._prev_ttft_total = tt
         spares = len(dead) + (1 if self.replica_factory is not None else 0)
         return FleetSnapshot(healthy=len(healthy), spares=spares,
                              queued=queued, active=active,
-                             latency_p99_ms=p99,
+                             latency_p99_ms=p99, ttft_p99_ms=ttft_p99,
                              kv_headroom_bytes=headroom)
 
     # -- actuation (never under self._lock) ----------------------------------

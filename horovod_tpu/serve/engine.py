@@ -52,6 +52,7 @@ engine-mechanics tests (``MLPAdapter``: next token = argmax MLP(one-hot
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import threading
@@ -130,6 +131,18 @@ class ModelAdapter:
 
     vocab_size: int
     max_len: int
+
+    def token_strings(self) -> Optional[List[str]]:
+        """Token id → emitted text, the vocabulary hvdstream structured
+        decoding builds its grammar masks over (serve/structured.py).
+        The default maps byte-level vocabs (``vocab_size <= 256``) to
+        their character identity; adapters over subword vocabularies
+        must override with their detokenizer or return None — a None
+        vocabulary makes ``schema`` requests fail with HTTP 400 rather
+        than constrain against a fictional mapping."""
+        if self.vocab_size <= 256:
+            return [chr(i) for i in range(self.vocab_size)]
+        return None
 
     def init_cache(self, max_batch: int):
         raise NotImplementedError
@@ -262,6 +275,7 @@ class TransformerAdapter(ModelAdapter):
         self._verify_cache: Dict[Tuple[int, int, int], object] = {}
         self._decode_fns: Dict[int, object] = {}
         self._paged_decode_fns: Dict[Tuple[int, int], object] = {}
+        self._paged_logits_fns: Dict[Tuple[int, int], object] = {}
         self._sampled_decode_fns: Dict[Tuple[int, int], object] = {}
         self._draft_decode_fns: Dict[Tuple[int, int], object] = {}
         self._copy_block_fn = None
@@ -605,6 +619,32 @@ class TransformerAdapter(ModelAdapter):
             jnp.asarray(table), need, len(prompt))
         return np.asarray(logits)[0]
 
+    def score_logits(self, tokens: Sequence[int]) -> np.ndarray:
+        """``prompt_logits`` generalized to ALL positions: the LM logits
+        ``[T, V]`` at every position of ``tokens`` through the real
+        paged pipeline on a throwaway pool (``logits[p]`` is the model's
+        distribution over the token at position ``p + 1``) — the
+        ``/score`` endpoint's forward (docs/serving.md).  Shares
+        ``_chunk_body`` with the speculative ``verify_chunk`` program,
+        so scoring sees exactly the serving math, storage quantization
+        included."""
+        import jax.numpy as jnp
+        if not 0 < len(tokens) <= self.max_len:
+            raise ValueError(f"token count {len(tokens)} outside "
+                             f"(0, {self.max_len}]")
+        MB = self.max_blocks_per_seq
+        need = -(-len(tokens) // self.block_tokens)
+        pool = self._pool_arrays(need)
+        table = np.full((1, MB), need, np.int32)
+        table[0, :need] = np.arange(need)
+        _, x = self._chunk_body(
+            self.params, pool,
+            jnp.asarray(np.asarray(tokens, np.int32)[None]),
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray([len(tokens)], jnp.int32),
+            jnp.asarray(table), need, len(tokens))
+        return np.asarray(self._logits(x, self.params))[0]
+
     def prefill_chunk(self, cache, chunks, starts, tables):
         """One iteration's prompt chunks: ``chunks[i]`` continues sequence
         i's prompt at absolute position ``starts[i]`` with physical blocks
@@ -828,6 +868,34 @@ class TransformerAdapter(ModelAdapter):
         cache, nxt = self._paged_decode_fns[key](*call_args)
         return cache, np.asarray(nxt)
 
+    def _build_paged_decode_logits(self, B: int):
+        import jax
+
+        def fn(params, cache, tokens, positions, tables):
+            return self._paged_step_body(
+                params, cache, tokens, positions, tables, self.num_layers)
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def decode_paged_logits(self, cache, tokens, positions, tables):
+        """``decode_paged`` returning each row's raw LM logits ``[B, V]``
+        instead of their argmax — the hvdstream host-mode decode step:
+        grammar-masked token selection and top-k logprob extraction both
+        need the full distribution on the host (serve/structured.py,
+        docs/serving.md)."""
+        import jax.numpy as jnp
+        key = (int(cache["k"].shape[1]), len(tokens))
+        if self._paged_logits_fns.get(key) is None:
+            self._paged_logits_fns[key] = self._build_paged_decode_logits(
+                len(tokens))
+        call_args = (self.params, cache, jnp.asarray(tokens, jnp.int32),
+                     jnp.asarray(positions, jnp.int32),
+                     jnp.asarray(tables, jnp.int32))
+        self._maybe_analyze("decode_paged_logits", key,
+                            self._paged_logits_fns[key], call_args)
+        cache, logits = self._paged_logits_fns[key](*call_args)
+        return cache, np.asarray(logits)
+
     def _build_paged_decode_sampled(self, B: int):
         """The paged decode program with in-jit seeded sampling: same
         forward as ``_build_paged_decode``, but the LM logits feed
@@ -1016,6 +1084,24 @@ class MLPAdapter(ModelAdapter):
     def decode_paged(self, cache, tokens, positions, tables):
         return self.decode(cache, tokens, positions)
 
+    def decode_paged_logits(self, cache, tokens, positions, tables):
+        # Host-mode decode (hvdstream): the raw distribution per row.
+        return cache, np.asarray(
+            self._logits_of(np.asarray(tokens, np.int32)))
+
+    def prompt_logits(self, prompt) -> np.ndarray:
+        # Markov chain: the final-position distribution depends only on
+        # the last prompt token (the /score parity reference).
+        return np.asarray(
+            self._logits_of(np.asarray([prompt[-1]], np.int32)))[0]
+
+    def score_logits(self, tokens) -> np.ndarray:
+        if not 0 < len(tokens) <= self.max_len:
+            raise ValueError(f"token count {len(tokens)} outside "
+                             f"(0, {self.max_len}]")
+        return np.asarray(
+            self._logits_of(np.asarray(tokens, np.int32)))
+
     def decode_paged_sampled(self, cache, tokens, positions, tables,
                              keys, temps, top_ks, top_ps):
         import jax.numpy as jnp
@@ -1060,7 +1146,8 @@ class _Seq:
     __slots__ = ("request", "length", "prompt_pos", "table", "hashes",
                  "admit_seq", "published", "generated", "group",
                  "sample_index", "base_key", "parked", "resident",
-                 "pending_fetch", "host_kv", "swap_step", "tier_credit")
+                 "pending_fetch", "host_kv", "swap_step", "tier_credit",
+                 "gstate")
 
     def __init__(self, request: Request, cached_tokens: int,
                  table: List[int], hashes: List[int], admit_seq: int):
@@ -1087,6 +1174,13 @@ class _Seq:
         self.host_kv: Optional[list] = None
         self.swap_step = 0
         self.tier_credit = 0
+        # hvdstream structured decoding (serve/structured.py): the
+        # grammar automaton state AFTER the tokens in ``generated``.  A
+        # preemption/requeue builds a fresh _Seq, so replayed decoding
+        # restarts from ``request.grammar.start`` in lockstep with the
+        # emptied token list.
+        self.gstate = (request.grammar.start
+                       if request.grammar is not None else None)
 
     @property
     def decoding(self) -> bool:
@@ -1313,6 +1407,11 @@ class InferenceEngine:
         # that forked at all.
         self.seq_forks = 0
         self.forked_requests = 0
+        # Compiled token grammars (serve/structured.py), keyed by
+        # (model, vocab_size, canonical schema JSON, eos) — compiling a
+        # DFA over the vocab is pure and deterministic, so identical
+        # schemas against the same resident model share one automaton.
+        self._grammar_cache: Dict[tuple, object] = {}
         self._slots: List[Optional[object]] = [None] * self.max_batch
         # Deferred trace emissions (loop-thread only): span/flow
         # emission does shard-file IO under the tracer's lock, and the
@@ -1499,6 +1598,69 @@ class InferenceEngine:
 
     def _adapter_for(self, model: Optional[str]):
         return self._adapters[model or self.default_model]
+
+    def _grammar_for(self, ad, r: Request):
+        """Compile (or fetch the cached) token-level grammar automaton
+        for ``r.schema`` against adapter ``ad``'s vocabulary
+        (serve/structured.py).  Raises ValueError on unsupported schema
+        keywords or a byte-opaque vocabulary — surfaced as a 400."""
+        from .structured import TokenGrammar
+        if r.eos_id is None:
+            raise ValueError(
+                "structured decoding needs eos_id (the grammar allows "
+                "EOS exactly at accepting states)")
+        vocab = ad.token_strings()
+        if vocab is None:
+            raise ValueError(
+                f"structured decoding needs a byte-transparent "
+                f"vocabulary; {type(ad).__name__} (vocab_size="
+                f"{ad.vocab_size}) does not expose token strings")
+        key = (r.model or self.default_model, int(ad.vocab_size),
+               json.dumps(r.schema, sort_keys=True), int(r.eos_id))
+        g = self._grammar_cache.get(key)
+        if g is None:
+            g = TokenGrammar(r.schema, vocab, int(r.eos_id))
+            self._grammar_cache[key] = g
+        return g
+
+    def score_tokens(self, tokens: Sequence[int],
+                     model: Optional[str] = None,
+                     top: int = 0) -> List[Optional[dict]]:
+        """Per-token logprobs of ``tokens`` under the resident model —
+        the /score endpoint (docs/serving.md).  Runs the adapter's
+        ``score_logits`` program over a throwaway paged pool WITHOUT the
+        engine lock (same discipline as ``prompt_logits``: pure forward,
+        no shared slot/pool state touched).  Entry ``p`` is ``None`` at
+        position 0 (nothing conditions it) and otherwise ``{"token",
+        "logprob"[, "top"]}`` where ``logprob`` is
+        ``log_softmax(logits[p-1])[token]``."""
+        ad = self._adapter_for(model)
+        if not hasattr(ad, "score_logits"):
+            raise ValueError(
+                f"{type(ad).__name__} has no score_logits program; "
+                f"/score needs a paged-capable adapter")
+        tokens = [int(t) for t in tokens]
+        for t in tokens:
+            if not 0 <= t < ad.vocab_size:
+                raise ValueError(
+                    f"token {t} out of range [0, {ad.vocab_size})")
+        logits = np.asarray(ad.score_logits(tokens), np.float64)
+        out: List[Optional[dict]] = []
+        for p, t in enumerate(tokens):
+            if p == 0:
+                out.append(None)
+                continue
+            row = logits[p - 1]
+            m = float(np.max(row))
+            lse = m + math.log(float(np.sum(np.exp(row - m))))
+            entry = {"token": t, "logprob": float(row[t] - lse)}
+            if top > 0:
+                idx = np.argsort(row)[::-1][:top]
+                entry["top"] = [
+                    {"token": int(i), "logprob": float(row[i] - lse)}
+                    for i in idx]
+            out.append(entry)
+        return out
 
     def _prefix_salt(self, model: Optional[str]) -> int:
         from .registry import model_salt
@@ -1744,6 +1906,10 @@ class InferenceEngine:
                     continue  # another member of the same fork family
                 seen.add(id(r))
                 r.generated = []
+                if r.token_logprobs is not None:
+                    # Replay regenerates logprobs from position 0; the
+                    # stream sink's dedupe keeps delivery exactly-once.
+                    r.token_logprobs = []
                 if r.samples is not None:
                     r.samples = [None] * r.n
                 group = getattr(s, "group", None)  # slot mode holds _Slot
@@ -1765,16 +1931,69 @@ class InferenceEngine:
 
     @staticmethod
     def _finished(r: Request, token: int) -> bool:
-        return (len(r.generated) >= r.max_new_tokens
-                or (r.eos_id is not None and token == r.eos_id))
+        if r.eos_id is not None and token == r.eos_id:
+            r.finish_reason = "stop"
+            return True
+        if len(r.generated) >= r.max_new_tokens:
+            r.finish_reason = "length"
+            return True
+        return False
 
     @staticmethod
     def _seq_finished(s: "_Seq", token: int) -> bool:
         """Per-sequence finish check (paged mode): a fork finishes on
-        its OWN stream, not the request's sample-0 mirror."""
+        its OWN stream, not the request's sample-0 mirror.  Finish
+        decisions record ``finish_reason`` on n==1 requests (hvdstream:
+        the terminal event / response field): ``stop`` (EOS), ``length``
+        (max_new_tokens), or ``grammar`` — the structured-decoding
+        automaton reached an accepting state with no continuation, so
+        the document is complete and decoding further could only break
+        it."""
         r = s.request
-        return (len(s.generated) >= r.max_new_tokens
-                or (r.eos_id is not None and token == r.eos_id))
+        solo = s.group is None
+        if r.eos_id is not None and token == r.eos_id:
+            if solo:
+                r.finish_reason = "stop"
+            return True
+        if len(s.generated) >= r.max_new_tokens:
+            if solo:
+                r.finish_reason = "length"
+            return True
+        if (r.grammar is not None and s.gstate is not None
+                and r.grammar.exhausted(s.gstate)):
+            r.finish_reason = "grammar"
+            return True
+        return False
+
+    @staticmethod
+    def _publish_stream(r: Request, generated: List[int],
+                        logprob=None) -> None:
+        """Offer the just-appended last token of ``generated`` to the
+        request's streaming sink (hvdstream, serve/streaming.py).  Holds
+        whatever lock the caller holds — publish is non-blocking and
+        never does IO, which is the never-hold-the-engine-lock-across-
+        socket-writes contract; position-keyed dedupe in the sink makes
+        failover/preemption replays invisible to the client."""
+        if r.sink is not None:
+            r.sink.publish(len(generated) - 1, generated[-1], logprob)
+
+    @staticmethod
+    def _logprob_entry(raw, tok: int, k: int) -> dict:
+        """One ``token_logprobs`` record (hvdstream ``logprobs: k``):
+        the chosen token's log-probability under the RAW logits — before
+        any grammar mask or temperature/top-k/top-p filter, so the
+        number is the model's own belief — plus the top-``k``
+        alternatives from the same distribution."""
+        row = np.asarray(raw, np.float64)
+        m = float(np.max(row))
+        lse = m + math.log(float(np.sum(np.exp(row - m))))
+        entry = {"token": int(tok), "logprob": float(row[tok] - lse)}
+        if k > 0:
+            idx = np.argsort(row)[::-1][:k]
+            entry["top"] = [{"token": int(i),
+                             "logprob": float(row[i] - lse)}
+                            for i in idx]
+        return entry
 
     def _retire_seq(self, i: int, s: "_Seq") -> None:
         """Free one finished sequence's slot + block refs and complete
@@ -1880,6 +2099,11 @@ class InferenceEngine:
 
     def _complete(self, r: Request) -> None:
         now = time.monotonic()
+        if r.finish_reason is None:
+            # The engine-cap retirement paths (s.length >= max_len)
+            # complete without a _finished verdict — the client-visible
+            # reason is the same as exhausting max_new_tokens.
+            r.finish_reason = "length"
         if r.first_token_at is not None:
             r.stage_add("decode", now)
         # Stage decomposition feeds /metrics unconditionally (the
@@ -1971,6 +2195,15 @@ class InferenceEngine:
                 f"({time.monotonic() - r.submitted_at:.3f}s since submit)"))
             self.metrics.count_request("expired", tenant=r.tenant)
             return True
+        # Client gone before prefill (hvdstream): the handler flagged a
+        # write-time disconnect — never spend the prefill on a request
+        # nobody is reading.
+        if r.cancelled:
+            r.fail(RuntimeError(
+                f"{r.request_id} client disconnected before prefill"))
+            self.metrics.count_request(r.cancel_reason or "client_gone",
+                                       tenant=r.tenant)
+            return True
         # Unknown model variant: routing filters candidates on residency
         # (replica.submit), so this fires only for direct engine submits
         # or a variant that left the fleet between routing and admission
@@ -2008,6 +2241,27 @@ class InferenceEngine:
                 f"max_batch {self.max_batch} decode slots"))
             self.metrics.count_request("error", tenant=r.tenant)
             return True
+        # hvdstream structured decoding / per-token logprobs need the
+        # paged engine's host-mode decode step (raw logits on the host:
+        # decode_paged_logits) — fail loudly rather than silently drop
+        # the mask or the logprobs (serve/structured.py, docs/serving.md).
+        if r.schema is not None or r.logprobs is not None:
+            if (self.kv_mode != "paged" or not self._sample_capable
+                    or not hasattr(ad, "decode_paged_logits")):
+                r.fail(ValueError(
+                    f"{r.request_id}: schema/logprobs need a paged "
+                    f"engine and an adapter with decode_paged_logits + "
+                    f"prefill_chunk_logits (kv_mode={self.kv_mode}, "
+                    f"adapter {type(ad).__name__})"))
+                self.metrics.count_request("error", tenant=r.tenant)
+                return True
+        if r.schema is not None and r.grammar is None:
+            try:
+                r.grammar = self._grammar_for(ad, r)
+            except ValueError as e:
+                r.fail(ValueError(f"{r.request_id}: {e}"))
+                self.metrics.count_request("error", tenant=r.tenant)
+                return True
         # Same cost formula as admission's cost/hard_cap (incl.
         # kv_token_cost and the n>1 shared-prompt + n-tails shape) — a
         # mismatch would let _take's hard_cap bypass pop a request this
@@ -2033,7 +2287,8 @@ class InferenceEngine:
         with self._lock:
             failed = set()
             for i, s in enumerate(self._slots):
-                if s is None or not s.request.expired(now):
+                if s is None or not (s.request.expired(now)
+                                     or s.request.cancelled):
                     continue
                 # A fork family expires as one unit: fail/count once,
                 # free every member slot's blocks (this loop visits each
@@ -2045,17 +2300,32 @@ class InferenceEngine:
                     gen = getattr(s, "generated", None)
                     ntokens = len(gen if gen is not None
                                   else s.request.generated)
-                    s.request.fail(DeadlineExceededError(
-                        f"{s.request.request_id} deadline expired "
-                        f"mid-flight ({ntokens} token(s) "
-                        f"generated)"))
-                    self.metrics.count_request("expired",
+                    if s.request.expired(now):
+                        s.request.fail(DeadlineExceededError(
+                            f"{s.request.request_id} deadline expired "
+                            f"mid-flight ({ntokens} token(s) "
+                            f"generated)"))
+                        outcome, mark = "expired", "deadline-expired"
+                    else:
+                        # hvdstream: the handler observed the client
+                        # hang up mid-stream and called cancel() — the
+                        # engine reaps the sequence here, at the same
+                        # boundary deadline expiry uses, so blocks are
+                        # freed and the slot reopens within one
+                        # iteration (docs/serving.md streaming).
+                        s.request.fail(RuntimeError(
+                            f"{s.request.request_id} client "
+                            f"disconnected mid-flight ({ntokens} "
+                            f"token(s) generated)"))
+                        outcome = s.request.cancel_reason or "client_gone"
+                        mark = "client-gone"
+                    self.metrics.count_request(outcome,
                                                tenant=s.request.tenant)
                     if s.request.trace is not None \
                             and _obs.TRACER is not None:
                         def emit(t=_obs.TRACER, r=s.request, now=now,
-                                 ntok=ntokens):
-                            t.instant(r.trace, "deadline-expired",
+                                 ntok=ntokens, mark=mark):
+                            t.instant(r.trace, mark,
                                       self.replica_id,
                                       args={"tokens": ntok}, t=now)
                         self._trace_emits.append(emit)
@@ -2121,6 +2391,7 @@ class InferenceEngine:
                     r.replica_id = self.replica_id
                     r.first_token_at = now
                     r.generated.append(int(tok))
+                    self._publish_stream(r, r.generated)
                     r.stage_add("prefill", now)
                     self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
                     if r.trace is not None and _obs.TRACER is not None:
@@ -2173,6 +2444,7 @@ class InferenceEngine:
                     continue  # drained concurrently
                 tok = int(nxt[i])
                 s.request.generated.append(tok)
+                self._publish_stream(s.request, s.request.generated)
                 s.length += 1
                 self._defer_flow(s.request)
                 if self._finished(s.request, tok) \
@@ -2829,7 +3101,9 @@ class InferenceEngine:
         # key).  Greedy-only batches keep the token-only program — the
         # pre-sampling fast path, bit-for-bit.
         use_logits = self._sample_capable and any(
-            s.request.sampled or s.request.n > 1 for _, s, _ in sel)
+            s.request.sampled or s.request.n > 1
+            or s.request.grammar is not None
+            or s.request.logprobs is not None for _, s, _ in sel)
         t0 = time.monotonic()
         # Multi-model partition: one chunk-prefill call per resident
         # variant in this selection, threading the SHARED pool cache
@@ -2912,15 +3186,33 @@ class InferenceEngine:
                     # prompt blocks.
                     self._fork_group(s, tok, now)
                     continue
+                entry = None
                 if use_logits:
-                    tok = (_sampling.sample_host(
-                        tok, s.base_key, len(r.prompt), r.temperature,
-                        r.top_k, r.top_p) if r.sampled
-                        else int(np.argmax(tok)))
+                    # hvdstream host rows: the grammar mask rides
+                    # sample_host's ``allowed`` hook (greedy = masked
+                    # argmax, sampled = mask-then-filter), and logprob
+                    # records read the RAW row before either.
+                    mask = (r.grammar.allowed_mask(s.gstate)
+                            if r.grammar is not None else None)
+                    if r.sampled or mask is not None:
+                        raw = tok
+                        tok = _sampling.sample_host(
+                            raw, s.base_key, len(r.prompt),
+                            r.temperature, r.top_k, r.top_p,
+                            allowed=mask)
+                    else:
+                        raw = tok
+                        tok = int(np.argmax(tok))
+                    if r.logprobs is not None:
+                        entry = self._logprob_entry(raw, tok, r.logprobs)
+                        r.token_logprobs.append(entry)
                 else:
                     tok = int(tok)
+                if r.grammar is not None and tok != r.eos_id:
+                    s.gstate = r.grammar.advance_token(s.gstate, tok)
                 r.first_token_at = now
                 s.generated.append(tok)
+                self._publish_stream(r, s.generated, entry)
                 r.stage_add("prefill", now)
                 self.metrics.observe_ttft((now - r.submitted_at) * 1e3)
                 self._defer_flow(r)
@@ -2957,6 +3249,8 @@ class InferenceEngine:
             s.group.forked = False
             s.request.samples = [None] * s.request.n
         s.request.generated = []
+        if s.request.token_logprobs is not None:
+            s.request.token_logprobs = []
         s.request.requeues += 1
         now = time.monotonic()
         s.request.resubmitted_at = now
@@ -3083,8 +3377,49 @@ class InferenceEngine:
             groups.setdefault(s.request.model, []).append((i, s))
         t0 = time.monotonic()
         nxt_by_slot: Dict[int, int] = {}
+        entry_by_slot: Dict[int, dict] = {}
         for model, members in groups.items():
             ad = self._adapter_for(model)
+            # hvdstream host-mode rows (structured decoding / per-token
+            # logprobs) need the RAW logit row on the host each step:
+            # they run their own decode_paged_logits call (same paged
+            # programs underneath, logits instead of a fused argmax) and
+            # draw on the host — sample_host with the grammar mask on
+            # the ``allowed`` hook is bit-identical to the fused device
+            # draw for unmasked rows (the batched==single contract), so
+            # a request only pays the logit transfer when it asked for
+            # one of the two features.
+            host = [(i, s) for i, s in members
+                    if s.request.grammar is not None
+                    or s.request.logprobs is not None]
+            if host:
+                members = [(i, s) for i, s in members
+                           if s.request.grammar is None
+                           and s.request.logprobs is None]
+                h_tokens = np.zeros((self.max_batch,), np.int32)
+                h_positions = np.zeros((self.max_batch,), np.int32)
+                h_tables = np.full((self.max_batch, self._mb), nb,
+                                   np.int32)
+                for i, s in host:
+                    h_tokens[i] = s.generated[-1]
+                    h_positions[i] = s.length
+                    h_tables[i, :len(s.table)] = s.table
+                self._cache, h_logits = ad.decode_paged_logits(
+                    self._cache, h_tokens, h_positions, h_tables)
+                for i, s in host:
+                    r = s.request
+                    raw = h_logits[i]
+                    mask = (r.grammar.allowed_mask(s.gstate)
+                            if r.grammar is not None else None)
+                    tok = _sampling.sample_host_fused(
+                        raw, s.base_key, s.length + 1, r.temperature,
+                        r.top_k, r.top_p, allowed=mask)
+                    nxt_by_slot[i] = tok
+                    if r.logprobs is not None:
+                        entry_by_slot[i] = self._logprob_entry(
+                            raw, tok, r.logprobs)
+                if not members:
+                    continue
             tokens = np.zeros((self.max_batch,), np.int32)
             positions = np.zeros((self.max_batch,), np.int32)
             tables = np.full((self.max_batch, self._mb), nb, np.int32)
@@ -3132,7 +3467,15 @@ class InferenceEngine:
                 if self._slots[i] is not s:
                     continue  # drained/preempted concurrently
                 tok = nxt_by_slot[i]
+                r = s.request
                 s.generated.append(tok)
+                entry = entry_by_slot.get(i)
+                if entry is not None and r.token_logprobs is not None:
+                    r.token_logprobs.append(entry)
+                if r.grammar is not None and tok != r.eos_id:
+                    s.gstate = r.grammar.advance_token(s.gstate, tok)
+                if s.group is None:
+                    self._publish_stream(r, s.generated, entry)
                 s.length += 1
                 self._defer_flow(s.request)
                 if self._seq_finished(s, tok) \
@@ -3299,6 +3642,8 @@ class InferenceEngine:
                 finished = False
                 for tok in emit:
                     s.generated.append(tok)
+                    if s.group is None:
+                        self._publish_stream(r, s.generated)
                     emitted_total += 1
                     self._defer_flow(r)
                     if self._seq_finished(s, tok):
@@ -3447,11 +3792,20 @@ class InferenceEngine:
                     # bit-identical output, just no draft amortization
                     # that iteration.
                     spec_ok = self.spec_k > 0 and self.brownout_level < 3
-                    if spec_ok and len(self._adapters) > 1:
+                    if spec_ok:
+                        # Grammar/logprob rows decode on the host
+                        # (decode_paged_logits) — the fused spec
+                        # draft/verify pair has no logits or mask seam,
+                        # so any such active row falls the whole
+                        # iteration back to the plain per-model path
+                        # (bit-identical output, hvdstream contract).
                         with self._lock:
                             spec_ok = all(
-                                s.request.model is None
-                                or s.request.model == self.default_model
+                                (s.request.model is None
+                                 or s.request.model == self.default_model
+                                 or len(self._adapters) == 1)
+                                and s.request.grammar is None
+                                and s.request.logprobs is None
                                 for s in self._slots if s is not None)
                     dec = (self._spec_once() if spec_ok
                            else self._decode_once_paged())

@@ -317,6 +317,16 @@ class ServeMetrics:
                 return ([], [], 0)
             return (list(h.bounds), list(h.counts), h.count)
 
+    def ttft_window(self):
+        """``(bounds, cumulative bucket counts, total count)`` snapshot
+        of the time-to-first-token histogram — same diffing contract as
+        :meth:`request_window`, feeding the controller's interactive
+        TTFT SLO term (streamed clients feel TTFT, not end-to-end
+        latency, so the pressure ladder may watch it directly)."""
+        with self._lock:
+            h = self.ttft_ms
+            return (list(h.bounds), list(h.counts), h.count)
+
     def set_brownout_level(self, level: int, reason: str = "") -> None:
         """Controller rung walk: gauge update + BROWNOUT timeline
         instant (``reason`` is the action, e.g. ``brownout_up``)."""

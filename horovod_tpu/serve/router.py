@@ -74,6 +74,7 @@ from ..utils import get_logger
 from .blocks import chain_hashes
 from .metrics import Histogram
 from .registry import model_salt
+from .streaming import encode_sse, wants_stream
 
 
 def _env_float(name: str, default: float) -> float:
@@ -305,6 +306,40 @@ class RouterMetrics:
 _DEFINITIVE = frozenset((504,)) | frozenset(range(200, 500))
 
 
+class _StreamReader:
+    """A live backend event-stream held open across :meth:`Router.handle`.
+
+    ``read1`` returns decoded SSE bytes from at most ONE underlying
+    chunk (``HTTPResponse.read1`` — a plain ``read(n)`` would block
+    accumulating ``n`` bytes and destroy time-to-first-token), ``b""``
+    at end of stream.  ``close()`` hangs up the connection: the backend
+    sees a client disconnect at its next write and aborts the sequence
+    (slot freed, blocks released) — this is how an abandoned hedge
+    loser or a vanished downstream client propagates."""
+
+    __slots__ = ("_conn", "_resp", "on_close", "_closed")
+
+    def __init__(self, conn, resp):
+        self._conn = conn
+        self._resp = resp
+        self.on_close = None
+        self._closed = False
+
+    def read1(self, n: int = 8192) -> bytes:
+        return self._resp.read1(n)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            self.on_close()
+
+
 class Router:
     """Prefix-affinity routing + retry/hedge/health core.  Transport-
     agnostic below :meth:`handle`: tests monkeypatch :meth:`_transport`
@@ -510,11 +545,47 @@ class Router:
         finally:
             conn.close()
 
+    def _transport_stream(self, ep_host: str, ep_port: int, method: str,
+                          path: str, body: Optional[bytes], headers,
+                          timeout_s: float):
+        """Streaming twin of :meth:`_transport` — its OWN seam so the
+        many tests that monkeypatch ``_transport`` keep exercising the
+        buffered path unchanged.  Returns ``(status, header dict, body
+        bytes or None, reader or None)``: a 200 ``text/event-stream``
+        answer comes back with the connection still open as a
+        :class:`_StreamReader` (body None); anything else is read to
+        completion and closed, exactly like ``_transport`` (reader
+        None).  The socket timeout gets slack past the client budget so
+        the BACKEND's own deadline machinery answers first (a 504 error
+        event beats a router-side socket timeout)."""
+        conn = http.client.HTTPConnection(
+            ep_host, ep_port,
+            timeout=max(min(timeout_s + 5.0, 3600.0), 0.001))
+        try:
+            conn.request(method, path, body=body, headers=dict(headers))
+            resp = conn.getresponse()
+        except Exception:
+            conn.close()
+            raise
+        ctype = resp.getheader("Content-Type") or ""
+        if resp.status != 200 or "text/event-stream" not in ctype:
+            try:
+                data = resp.read()
+            finally:
+                conn.close()
+            return resp.status, dict(resp.getheaders()), data, None
+        return (resp.status, dict(resp.getheaders()), None,
+                _StreamReader(conn, resp))
+
     def _forward_once(self, name: str, body: bytes, headers,
-                      timeout_s: float):
+                      timeout_s: float, want_stream: bool = False):
         """One forward attempt: faultline consult, blackhole gate, then
         the transport.  Raises ``ConnectionError``/``OSError`` on
-        transport failure; returns (status, headers, body)."""
+        transport failure; returns (status, headers, body), or with
+        ``want_stream`` (status, headers, body-or-None, reader-or-None)
+        via :meth:`_transport_stream`.  A live reader keeps the
+        endpoint's inflight gauge held until ``close()`` — the bounded-
+        load signal must see open streams, not just open exchanges."""
         now = time.monotonic()
         if _faultline.PLAN is not None:
             # ``router.forward`` injection point, consulted once per
@@ -549,15 +620,31 @@ class Router:
             host, port = ep.host, ep.port
         self.metrics.count("forwards")
         try:
-            return self._transport(host, port, "POST", "/generate",
-                                   body, headers, timeout_s)
+            if want_stream:
+                status, hdrs, data, reader = self._transport_stream(
+                    host, port, "POST", "/generate", body, headers,
+                    timeout_s)
+            else:
+                reader = None
+                status, hdrs, data = self._transport(
+                    host, port, "POST", "/generate", body, headers,
+                    timeout_s)
         except (OSError, http.client.HTTPException) as e:
+            self._release_inflight(name)
             raise ConnectionError(f"forward to {name} failed: {e}") from e
-        finally:
-            with self._lock:
-                ep = self._endpoints.get(name)
-                if ep is not None:
-                    ep.inflight = max(ep.inflight - 1, 0)
+        if reader is not None:
+            reader.on_close = lambda: self._release_inflight(name)
+            return status, hdrs, data, reader
+        self._release_inflight(name)
+        if want_stream:
+            return status, hdrs, data, None
+        return status, hdrs, data
+
+    def _release_inflight(self, name: str) -> None:
+        with self._lock:
+            ep = self._endpoints.get(name)
+            if ep is not None:
+                ep.inflight = max(ep.inflight - 1, 0)
 
     def _backoff_s(self, attempt: int) -> float:
         """Capped jittered exponential backoff — the KVStoreClient
@@ -618,6 +705,73 @@ class Router:
                     timeout=max(deadline - time.monotonic(), 0.001))
         raise errors[0][1]
 
+    def _hedged_forward_stream(self, primary: str, secondary: str,
+                               body: bytes, headers, deadline: float):
+        """Hedging for a streamed request: the race is decided at
+        FIRST BYTE (response headers received), never later.  The
+        winner is claimed atomically under ``claim_lock`` the moment
+        its attempt has an answer in hand; a loser that lands after
+        the claim closes its own connection — the backend sees the
+        hangup and aborts that sequence, so the fleet never decodes
+        two copies of the stream past the race window.  Errors still
+        flow to the caller's queue so a failed primary fails over to
+        the hedge exactly like the buffered race.  Returns (winner
+        name, status, headers, body-or-None, reader-or-None, hedged,
+        hedge_won)."""
+        results: "queue.Queue" = queue.Queue()
+        claim_lock = threading.Lock()
+        claimed: List[str] = []
+
+        def attempt(name: str) -> None:
+            try:
+                remaining = deadline - time.monotonic()
+                res = self._forward_once(name, body, headers,
+                                         max(remaining, 0.001),
+                                         want_stream=True)
+            except Exception as e:
+                results.put((name, None, e))
+                return
+            with claim_lock:
+                if not claimed:
+                    claimed.append(name)
+                    results.put((name, res, None))
+                    return
+            # Lost the first-byte race: abandon our own answer.  A live
+            # reader must be hung up (aborts the backend sequence);
+            # buffered answers were already read and closed.
+            if res[3] is not None:
+                res[3].close()
+
+        threading.Thread(target=attempt, args=(primary,), daemon=True,
+                         name="hvd-route-fwd").start()
+        launched = 1
+        hedged = False
+        try:
+            got = results.get(timeout=self.config.hedge_s)
+        except queue.Empty:
+            hedged = True
+            self.metrics.count("hedges")
+            threading.Thread(target=attempt, args=(secondary,),
+                             daemon=True, name="hvd-route-hedge").start()
+            launched = 2
+            got = results.get(
+                timeout=max(deadline - time.monotonic(), 0.001))
+        errors = []
+        for _ in range(launched):
+            name, res, err = got
+            if err is None:
+                hedge_won = hedged and name == secondary
+                if hedge_won:
+                    self.metrics.count("hedges_won")
+                return (name, res[0], res[1], res[2], res[3],
+                        hedged, hedge_won)
+            errors.append((name, err))
+            self._note_failure(name)
+            if len(errors) < launched:
+                got = results.get(
+                    timeout=max(deadline - time.monotonic(), 0.001))
+        raise errors[0][1]
+
     # -- request path ---------------------------------------------------------
 
     @staticmethod
@@ -635,15 +789,31 @@ class Router:
             return None
         return budget if budget is not None and budget > 0 else None
 
-    def handle(self, body: bytes, headers, ctx=None):
+    def handle(self, body: bytes, headers, ctx=None, stream=None):
         """Route one ``/generate`` request end to end.  Returns
         ``(status, [(header, value)], body bytes)`` — whatever transport
-        wraps this (router_server, tests) just writes it out."""
+        wraps this (router_server, tests) just writes it out.
+
+        ``stream`` is the pass-through seam for token streaming: a
+        callable ``stream(status, [(header, value)]) -> write`` the
+        router invokes once the backend's event-stream headers arrive;
+        ``write(bytes) -> bool`` forwards SSE payload bytes downstream
+        (False = downstream client gone), ``write(None)`` terminates
+        the response body.  When the request asks for streaming
+        (payload ``"stream": true`` or ``Accept: text/event-stream``)
+        AND a ``stream`` callback is given, a 200 event-stream answer
+        is piped chunk by chunk WITHOUT buffering and handle returns
+        ``(status, None, None)`` (body already delivered).  Everything
+        else — buffered answers, pre-first-byte errors, shed/expired —
+        returns the buffered triple unchanged, so a streaming client
+        still gets an ordinary JSON error when no stream ever opened."""
         t0 = time.monotonic()
         try:
             payload = json.loads(body or b"{}")
         except ValueError:
             payload = None
+        want_stream = stream is not None and wants_stream(
+            payload if isinstance(payload, dict) else {}, headers)
         tokens = payload.get("tokens") if isinstance(payload, dict) \
             else None
         model = payload.get("model") if isinstance(payload, dict) else None
@@ -668,7 +838,8 @@ class Router:
                 "big")
 
         fwd_headers = {"Content-Type": "application/json"}
-        for h in ("X-Request-Timeout-S", "X-QoS-Tier", "X-Tenant-Id"):
+        for h in ("X-Request-Timeout-S", "X-QoS-Tier", "X-Tenant-Id",
+                  "Accept"):
             v = headers.get(h)
             if v is not None:
                 fwd_headers[h] = v
@@ -686,7 +857,9 @@ class Router:
         failed: set = set()
         outcome = ("error", 502, {"error": "router: no forward attempted"})
         status, resp_headers, resp_body = None, {}, b""
+        reader = None
         while True:
+            reader = None
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 outcome = ("expired", 504,
@@ -717,10 +890,24 @@ class Router:
                              and self.config.hedge_s > 0
                              and len(cand) >= 2)
                 if use_hedge:
-                    (served_by, status, resp_headers, resp_body,
-                     hedged, hedge_won) = self._hedged_forward(
-                        cand[0], cand[1], body, fwd_headers, deadline)
+                    if want_stream:
+                        (served_by, status, resp_headers, resp_body,
+                         reader, hedged, hedge_won) = \
+                            self._hedged_forward_stream(
+                                cand[0], cand[1], body, fwd_headers,
+                                deadline)
+                    else:
+                        (served_by, status, resp_headers, resp_body,
+                         hedged, hedge_won) = self._hedged_forward(
+                            cand[0], cand[1], body, fwd_headers, deadline)
                     attempts += 2 if hedged else 1
+                elif want_stream:
+                    served_by = cand[0]
+                    (status, resp_headers, resp_body,
+                     reader) = self._forward_once(
+                        served_by, body, fwd_headers, remaining,
+                        want_stream=True)
+                    attempts += 1
                 else:
                     served_by = cand[0]
                     status, resp_headers, resp_body = self._forward_once(
@@ -777,6 +964,11 @@ class Router:
                 break
             time.sleep(min(self._backoff_s(attempts),
                            max(deadline - time.monotonic(), 0.0)))
+
+        if reader is not None:
+            return self._pipe_stream(
+                stream, reader, served_by, status, resp_headers, ctx,
+                t0, affinity, attempts, retries, hedged, hedge_won)
 
         now = time.monotonic()
         affinity_hit = (served_by is not None and served_by == affinity
@@ -839,6 +1031,65 @@ class Router:
             except Exception:
                 pass  # tracing must never take down the front door
         return status, out_headers, body_out
+
+    def _pipe_stream(self, stream, reader, served_by: str, status: int,
+                     resp_headers, ctx, t0: float, affinity,
+                     attempts: int, retries: int, hedged: bool,
+                     hedge_won: bool):
+        """Pipe a claimed backend event-stream downstream without
+        buffering.  Past the first byte there is NO silent retry: a
+        backend that dies mid-stream has already emitted tokens the
+        client consumed, and a seeded replay on another endpoint would
+        re-send them — so the failure surfaces as a terminal SSE
+        ``error`` event instead.  A downstream hangup closes the
+        backend connection (the engine aborts the sequence and frees
+        its blocks).  Returns ``(status, None, None)``: the body has
+        already been written through the ``stream`` callback."""
+        out_headers = [(k, v) for k, v in resp_headers.items()
+                       if k.lower() in ("content-type", "cache-control",
+                                        "x-trace-id")]
+        outcome = "ok"
+        write = None
+        try:
+            write = stream(status, out_headers)
+            while True:
+                try:
+                    data = reader.read1(8192)
+                except (OSError, http.client.HTTPException) as e:
+                    self._note_failure(served_by)
+                    outcome = "error"
+                    write(encode_sse("error", {
+                        "error": f"router: upstream {served_by} failed "
+                                 f"mid-stream: {e}",
+                        "code": 502}))
+                    break
+                if not data:
+                    break  # backend finished; its terminal event is sent
+                if not write(data):
+                    outcome = "client_gone"
+                    break
+            if outcome != "client_gone":
+                write(None)  # end of chunked body
+        except Exception:
+            outcome = "client_gone"
+        finally:
+            reader.close()
+        now = time.monotonic()
+        self.metrics.count_request(outcome)
+        self.metrics.observe_request(
+            (now - t0) * 1e3, served_by == affinity)
+        if ctx is not None and _obs.TRACER is not None:
+            try:
+                _obs.TRACER.emit_span(
+                    ctx, "route", t0, now, "router",
+                    args={"endpoint": served_by, "status": status,
+                          "attempts": attempts, "retries": retries,
+                          "hedged": hedged, "hedge_won": hedge_won,
+                          "affinity_hit": served_by == affinity,
+                          "streamed": True, "stream_outcome": outcome})
+            except Exception:
+                pass  # tracing must never take down the front door
+        return status, None, None
 
     # -- active health --------------------------------------------------------
 

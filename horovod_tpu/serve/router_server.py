@@ -32,6 +32,7 @@ from ..utils import get_logger
 from .router import Router
 from .server import (DrainingThreadingHTTPServer, _ServeHandler,
                      arm_signal_event, serve_until_signal)
+from .streaming import CHUNK_TERMINATOR, chunk_frame
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -81,6 +82,46 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     ("Connection", "close"))
         return (("Retry-After", str(hint)), ("Connection", "close"))
 
+    def _begin_stream(self, status: int, out_headers):
+        """Router.handle's ``stream`` callback: send the event-stream
+        response head, hand back a chunk writer.  ``write(bytes)``
+        frames SSE payload bytes as one HTTP/1.1 chunk (False =
+        downstream client hung up); ``write(None)`` ends the chunked
+        body.  ``Connection: close`` — the socket's framing ends with
+        the stream, same as the serve plane."""
+        self.send_response(status)
+        tid = (self._trace_ctx.trace_id if self._trace_ctx is not None
+               else self._trace_echo)
+        if tid is not None:
+            self.send_header("X-Trace-Id", tid)
+        sent = set()
+        for k, v in out_headers:
+            if k.lower() == "x-trace-id" and tid is not None:
+                continue  # this hop's id wins; the span tree links them
+            self.send_header(k, v)
+            sent.add(k.lower())
+        if "content-type" not in sent:
+            self.send_header("Content-Type", "text/event-stream")
+        if "cache-control" not in sent:
+            self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+
+        def write(data) -> bool:
+            try:
+                if data is None:
+                    self.wfile.write(CHUNK_TERMINATOR)
+                else:
+                    self.wfile.write(chunk_frame(data))
+                self.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+
+        return write
+
     def do_GET(self):
         self._trace_ctx = None
         self._trace_echo = _ServeHandler._safe_id(
@@ -128,8 +169,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             status = 500
             try:
                 status, headers, resp_body = self.server.router.handle(
-                    body, self.headers, ctx)
-                self._reply(status, resp_body, extra_headers=headers)
+                    body, self.headers, ctx, stream=self._begin_stream)
+                if headers is not None:
+                    self._reply(status, resp_body, extra_headers=headers)
+                # headers is None: an event-stream was piped through
+                # _begin_stream and the body is already on the wire.
             finally:
                 if ctx is not None and tracer is not None:
                     try:

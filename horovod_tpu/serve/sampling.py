@@ -134,13 +134,18 @@ def token_key(base_key: np.ndarray, position: int):
 # Filtered distributions (temperature -> top-k -> top-p)
 # ---------------------------------------------------------------------------
 
-def _filter_logits_jnp(logits, temperature, top_k, top_p):
+def _filter_logits_jnp(logits, temperature, top_k, top_p, allowed=None):
     """One row's filtered sampling logits, traceable (used under vmap
     inside the decode programs).  ``top_k <= 0`` disables the top-k
-    filter; ``top_p == 1`` keeps every token."""
+    filter; ``top_p == 1`` keeps every token.  ``allowed`` (optional
+    boolean ``[V]`` mask — hvdstream structured decoding) removes
+    disallowed tokens BEFORE temperature/top-k/top-p, so the filters
+    operate on the constrained distribution."""
     import jax
     import jax.numpy as jnp
     V = logits.shape[-1]
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
     scaled = logits / jnp.maximum(temperature, jnp.float32(1e-6))
     desc = jnp.sort(scaled)[::-1]
     k_eff = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
@@ -158,12 +163,16 @@ def _filter_logits_jnp(logits, temperature, top_k, top_p):
 
 
 def filtered_probs(logits: np.ndarray, temperature: float,
-                   top_k: Optional[int], top_p: float) -> np.ndarray:
+                   top_k: Optional[int], top_p: float,
+                   allowed: Optional[np.ndarray] = None) -> np.ndarray:
     """Host mirror of ``_filter_logits_jnp`` as a probability vector —
     the target distribution ``p`` speculative rejection sampling must
     preserve (accept prob, residual resample) and the reference the
-    chi-square distribution test checks against."""
+    chi-square distribution test checks against.  ``allowed`` is the
+    structured-decoding pre-mask (see ``_filter_logits_jnp``)."""
     logits = np.asarray(logits, np.float32)
+    if allowed is not None:
+        logits = np.where(allowed, logits, -np.inf)
     V = logits.shape[-1]
     scaled = logits / max(float(temperature), 1e-6)
     desc = np.sort(scaled)[::-1]
@@ -229,14 +238,50 @@ def _draw_from_probs(probs: np.ndarray, u: float) -> int:
 
 def sample_host(logits: np.ndarray, base_key: np.ndarray, position: int,
                 temperature: float, top_k: Optional[int],
-                top_p: float) -> int:
+                top_p: float,
+                allowed: Optional[np.ndarray] = None) -> int:
     """One host-side token draw for the token occupying ``position`` —
     the first-token path after prefill (n>1 forks draw n tokens from one
-    logit row with n different base keys) and test references."""
+    logit row with n different base keys) and test references.
+
+    ``allowed`` (hvdstream structured decoding) constrains BOTH paths:
+    greedy becomes masked argmax, sampled applies the mask before the
+    temperature/top-k/top-p filters — so grammar masks ride the same
+    logit-filter hook on every decode flavor."""
     if temperature <= 0:
-        return int(np.argmax(np.asarray(logits)))
-    probs = filtered_probs(logits, temperature, top_k, top_p)
+        logits = np.asarray(logits)
+        if allowed is not None:
+            logits = np.where(allowed, logits, -np.inf)
+        return int(np.argmax(logits))
+    probs = filtered_probs(logits, temperature, top_k, top_p,
+                           allowed=allowed)
     return _draw_from_probs(probs, _uniform(token_key(base_key, position)))
+
+
+def sample_host_fused(logits, base_key, position: int,
+                      temperature: float, top_k: Optional[int],
+                      top_p: float, allowed=None) -> int:
+    """Host-side draw BIT-IDENTICAL to one fused device decode row
+    (``sample_batched``): ``categorical`` over the filtered logits under
+    the token's key — the same formula the jitted sampled program runs.
+    This is the hvdstream host-decode draw (engine rows carrying a
+    grammar mask or a logprobs request pull raw logits to the host):
+    using it means toggling ``logprobs`` on, or adding a mask that
+    happens to allow everything, never changes which tokens a seeded
+    sampled request produces.  (``sample_host`` keeps the inverse-CDF
+    draw the prefill-first-token and speculative paths are pinned to.)"""
+    if temperature <= 0:
+        logits = np.asarray(logits)
+        if allowed is not None:
+            logits = np.where(allowed, logits, -np.inf)
+        return int(np.argmax(logits))
+    import jax
+    import jax.numpy as jnp
+    return int(jax.random.categorical(
+        token_key(base_key, position),
+        _filter_logits_jnp(jnp.asarray(logits), temperature,
+                           int(top_k) if top_k else 0,
+                           top_p, allowed=allowed)))
 
 
 def accept_draw(base_key: np.ndarray, position: int) -> float:

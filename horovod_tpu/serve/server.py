@@ -54,11 +54,14 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..faultline import runtime as _faultline
 from ..obs import tracing as _obs
 from ..utils import get_logger
 from .batcher import DeadlineExceededError, QueueFullError, Request
 from .metrics import ServeMetrics
 from .replica import NoHealthyReplicaError, ReplicaScheduler
+from .streaming import (CHUNK_TERMINATOR, TokenStream, chunk_frame,
+                        encode_sse, error_status_for, wants_stream)
 
 
 class DrainingThreadingHTTPServer(ThreadingHTTPServer):
@@ -280,38 +283,19 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": f"unknown path {path}"})
 
     def do_POST(self):
-        # Drain refusal (docs/serving.md runbook): a draining server
-        # finishes in-flight work but accepts none — refused with 503 +
-        # Connection: close so the client reconnects elsewhere, and
-        # Retry-After clamped by the HEADER budget (no Request exists
-        # yet at this shed site).
-        if getattr(self.server, "draining", False):
-            self._trace_ctx = None
-            self._trace_echo = self._safe_id(
-                self.headers.get("X-Trace-Id"))
-            self._shed_log("draining", None, "refused: draining")
-            self._reply_json(
-                503, {"error": "draining: server is shutting down"},
-                extra_headers=tuple(self._budget_headers())
-                + (("Connection", "close"),))
-            return
-        began = getattr(self.server, "request_began", None)
-        if began is not None:
-            began()
-        try:
-            self._do_post_inner()
-        finally:
-            ended = getattr(self.server, "request_ended", None)
-            if ended is not None:
-                ended()
-
-    def _do_post_inner(self):
         # Trace ingress (docs/observability.md): an inbound X-Trace-Id
         # continues the upstream hop's trace (it made the sampling
         # decision); otherwise HVD_TRACE_SAMPLE decides.  The context
         # rides a contextvar for THIS thread's work (route, KV calls)
         # and travels on the Request object into the engine.  Untraced
         # requests still echo any inbound X-Trace-Id (_reply).
+        #
+        # EVERY POST outcome — buffered, streamed, /score, the drain
+        # refusal, 404s — flows through _route_post under this ONE
+        # root-span emission, so each response carries exactly one
+        # ``http-handle`` root with its final status (the drain refusal
+        # used to answer before the span machinery and left traced
+        # sheds rootless).
         tracer = _obs.TRACER
         hdr_tid = self._safe_id(self.headers.get("X-Trace-Id"))
         self._trace_echo = hdr_tid
@@ -323,17 +307,17 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 parent=self._safe_id(self.headers.get("X-Parent-Span")))
         self._trace_ctx = ctx
         if ctx is None:
-            self._handle_generate(None)
+            self._route_post(None)
             return
         t0 = time.monotonic()
         token = _obs.push(ctx)
-        # Default outcome when _handle_generate raises before replying
+        # Default outcome when the handler raises before replying
         # (e.g. a BrokenPipeError writing to a disconnected client):
         # the root span must still be emitted or exactly the
         # failure-path requests lose their http-handle root.
         status = 500
         try:
-            status = self._handle_generate(ctx)
+            status = self._route_post(ctx)
         finally:
             _obs.pop(token)
             try:
@@ -343,12 +327,40 @@ class _ServeHandler(BaseHTTPRequestHandler):
             except Exception:
                 pass  # tracing must never take down the HTTP plane
 
+    def _route_post(self, ctx) -> int:
+        # Drain refusal (docs/serving.md runbook): a draining server
+        # finishes in-flight work but accepts none — refused with 503 +
+        # Connection: close so the client reconnects elsewhere, and
+        # Retry-After clamped by the HEADER budget (no Request exists
+        # yet at this shed site).  Outside began/ended by design: the
+        # refusal must not hold the drain's own idle-wait hostage.
+        if getattr(self.server, "draining", False):
+            self._shed_log("draining", None, "refused: draining")
+            self._reply_json(
+                503, {"error": "draining: server is shutting down"},
+                extra_headers=tuple(self._budget_headers())
+                + (("Connection", "close"),))
+            return 503
+        began = getattr(self.server, "request_began", None)
+        if began is not None:
+            began()
+        try:
+            path = self.path.split("?", 1)[0]
+            if path == "/generate":
+                return self._handle_generate(ctx)
+            if path == "/score":
+                return self._handle_score(ctx)
+            self._reply_json(
+                404, {"error": f"POST /generate or /score, not {path}"})
+            return 404
+        finally:
+            ended = getattr(self.server, "request_ended", None)
+            if ended is not None:
+                ended()
+
     def _handle_generate(self, ctx) -> int:
         """The /generate body; returns the HTTP status it answered (the
         root span's outcome arg)."""
-        if self.path.split("?", 1)[0] != "/generate":
-            self._reply_json(404, {"error": "POST /generate only"})
-            return 404
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -392,6 +404,22 @@ class _ServeHandler(BaseHTTPRequestHandler):
                         for r in self.server.scheduler.fleet())
                 if not known:
                     raise ValueError(f"unknown model {model!r}")
+            # hvdstream interactive fields (docs/serving.md streaming):
+            # ``stream`` (body flag or Accept: text/event-stream),
+            # ``logprobs: k`` (per-token top-k), ``schema`` (grammar-
+            # constrained decoding).  The schema compiles HERE first —
+            # an unsupported keyword answers 400 immediately instead of
+            # surfacing as an engine-side failure after admission; the
+            # engine re-validates against the actual vocabulary.
+            stream = wants_stream(payload, self.headers)
+            schema = payload.get("schema")
+            if schema is not None:
+                from .structured import parse_schema
+                parse_schema(schema)
+                if payload.get("eos_id") is None:
+                    raise ValueError(
+                        "schema requires eos_id (EOS marks document "
+                        "completion at accepting states)")
             request = Request(
                 prompt,
                 max_new_tokens=int(payload.get("max_new_tokens", 16)),
@@ -409,7 +437,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 seed=payload.get("seed"),
                 qos=str(qos).strip().lower(),
                 tenant=str(tenant),
-                model=model)
+                model=model,
+                stream=stream,
+                logprobs=payload.get("logprobs"),
+                schema=schema)
         except (KeyError, TypeError, ValueError) as e:
             self._shed_log("bad_request", None, e)
             self._reply_json(400, {"error": str(e)})
@@ -419,6 +450,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
         # (or tracer off), and the scheduler must not re-roll it.
         request.trace = ctx
         request._sampling_decided = True
+        if request.stream:
+            # The sink attaches BEFORE submit: the engine's first
+            # publish may beat this thread back from submit().
+            request.sink = TokenStream(
+                logprobs=request.logprobs is not None)
         try:
             t_route = time.monotonic()
             replica = self.server.scheduler.submit(request)
@@ -429,6 +465,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
                         args={"replica": replica.replica_id})
                 except Exception:
                     pass
+            if request.stream:
+                return self._stream_response(request)
             tokens = request.result(timeout=self.server.request_timeout_s)
         except (QueueFullError, NoHealthyReplicaError) as e:
             self._shed_log("shed", request, e)
@@ -444,13 +482,27 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._shed_log("error", request, e)
             self._reply_json(500, {"error": str(e)})
             return 500
+        body = self._outcome_body(request)
+        body["tokens"] = tokens
+        if request.n > 1:
+            body["n"] = request.n
+            body["completions"] = request.samples
+        self._reply_json(200, body)
+        return 200
+
+    @staticmethod
+    def _outcome_body(request: Request) -> dict:
+        """The request-outcome fields shared VERBATIM by the buffered
+        200 body and the streamed terminal ``done`` event — one builder,
+        so "concatenated token events + terminal event == buffered
+        response" is a structural identity, not two hand-maintained
+        dicts."""
         ttft_ms = None
         if request.first_token_at is not None:
             ttft_ms = round(
                 (request.first_token_at - request.submitted_at) * 1e3, 3)
         body = {
             "request_id": request.request_id,
-            "tokens": tokens,
             "replica": request.replica_id,
             "requeues": request.requeues,
             "ttft_ms": ttft_ms,
@@ -460,12 +512,198 @@ class _ServeHandler(BaseHTTPRequestHandler):
             "seed": request.seed,
             "qos": request.qos,
             "tenant": request.tenant,
+            "finish_reason": request.finish_reason,
+            "usage": {
+                "prompt_tokens": len(request.prompt),
+                "completion_tokens": len(request.generated),
+                "total_tokens":
+                    len(request.prompt) + len(request.generated),
+            },
         }
         if request.model is not None:
             body["model"] = request.model
-        if request.n > 1:
-            body["n"] = request.n
-            body["completions"] = request.samples
+        if request.token_logprobs is not None:
+            body["logprobs"] = request.token_logprobs
+        return body
+
+    # -- streaming (hvdstream, serve/streaming.py) ---------------------------
+
+    def _write_stream_frame(self, request: Request, data: bytes) -> bool:
+        """One chunked-transfer write to the client, with the
+        ``stream.emit`` faultline point consulted first (docs/
+        fault_injection.md): ``slow-client`` stalls this handler thread
+        (the sink's bounded queue coalesces upstream — engine memory
+        stays bounded), ``stream-disconnect`` raises the same
+        BrokenPipeError a real mid-stream hangup produces.  Returns
+        False on a dead socket — the caller aborts the request in the
+        engine."""
+        try:
+            for f in _faultline.fire("stream.emit", request.request_id):
+                if f.kind == "slow-client":
+                    time.sleep(f.param or 0.05)
+                elif f.kind == "stream-disconnect":
+                    raise BrokenPipeError(
+                        "faultline: stream-disconnect injected")
+            self.wfile.write(data)
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            self._shed_log("client_gone", request, e)
+            return False
+
+    def _stream_response(self, request: Request) -> int:
+        """Write the /generate answer as SSE over chunked transfer
+        (serve/streaming.py wire helpers).  Status contract mirrors the
+        buffered path: errors BEFORE the first byte answer as ordinary
+        buffered JSON (400/503/504/500 — the client sees no difference
+        from a buffered shed); after the first byte the stream ends
+        with a terminal ``error`` event carrying the same code.  A dead
+        client socket at any write aborts the sequence in the engine
+        (``Request.cancel`` → slot freed, blocks released, the
+        ``client_gone`` outcome) and reports 499 to the root span."""
+        sink = request.sink
+        deadline = time.monotonic() + self.server.request_timeout_s
+        first = sink.next_event(
+            timeout=max(deadline - time.monotonic(), 0.0))
+        if first is None:
+            first = ("error", TimeoutError(
+                f"{request.request_id} server cap "
+                f"({self.server.request_timeout_s:.0f}s) expired before "
+                f"the first token"))
+        if first[0] == "error":
+            # Pre-first-byte failure: answer buffered, exactly like the
+            # non-streamed path would (budget headers on sheds).
+            exc = first[1]
+            status = error_status_for(exc)
+            if status == 504 and isinstance(exc, TimeoutError) \
+                    and not isinstance(exc, DeadlineExceededError):
+                request.cancel("server_cap")
+            self._shed_log(
+                {503: "shed", 504: "expired"}.get(status, "error"),
+                request, exc)
+            extra = (self._budget_headers(request)
+                     if status in (503, 504) else ())
+            self._reply_json(status, {"error": str(exc)},
+                             extra_headers=extra)
+            return status
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        # Streams own their connection: no keep-alive reuse after a
+        # body whose length was unknown up front.
+        self.send_header("Connection", "close")
+        tid = (self._trace_ctx.trace_id if self._trace_ctx is not None
+               else self._trace_echo)
+        if tid is not None:
+            self.send_header("X-Trace-Id", tid)
+            if self._trace_ctx is not None:
+                self.send_header("X-Span-Id", self._trace_ctx.span_id)
+        self.end_headers()
+        ev = first
+        while True:
+            kind, data = ev
+            if kind == "token":
+                if not self._write_stream_frame(
+                        request, chunk_frame(encode_sse("token", data))):
+                    request.cancel()
+                    return 499
+            elif kind == "done":
+                body = self._outcome_body(request)
+                body["stream"] = sink.counters()
+                ok = self._write_stream_frame(
+                    request, chunk_frame(encode_sse("done", body))
+                    + CHUNK_TERMINATOR)
+                return 200 if ok else 499
+            else:  # ("error", exc) — mid-stream terminal failure
+                exc = data
+                status = error_status_for(exc)
+                self._shed_log(
+                    {503: "shed", 504: "expired"}.get(status, "error"),
+                    request, exc)
+                ok = self._write_stream_frame(
+                    request, chunk_frame(encode_sse(
+                        "error", {"error": str(exc), "code": status}))
+                    + CHUNK_TERMINATOR)
+                return status if ok else 499
+            remaining = deadline - time.monotonic()
+            ev = sink.next_event(timeout=max(remaining, 0.0)) \
+                if remaining > 0 else None
+            if ev is None:
+                # Server-side cap expired MID-stream: the terminal is an
+                # error event (the buffered path's 504), and the engine
+                # must reap the still-decoding sequence.
+                request.cancel("server_cap")
+                exc = TimeoutError(
+                    f"{request.request_id} server cap "
+                    f"({self.server.request_timeout_s:.0f}s) expired "
+                    f"mid-stream")
+                self._shed_log("expired", request, exc)
+                ok = self._write_stream_frame(
+                    request, chunk_frame(encode_sse(
+                        "error", {"error": str(exc), "code": 504}))
+                    + CHUNK_TERMINATOR)
+                return 504 if ok else 499
+
+    # -- /score (hvdstream logprob scoring) ----------------------------------
+
+    def _handle_score(self, ctx) -> int:
+        """POST /score: per-token logprobs of the given tokens under
+        the model — teacher-forced through the real paged pipeline
+        (engine.score_tokens), no decoding.  Synchronous against one
+        healthy replica; position 0 scores null (nothing conditions
+        it)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            tokens = payload["tokens"]
+            if not isinstance(tokens, list) or not tokens:
+                raise ValueError("'tokens' must be a non-empty list")
+            tokens = [int(t) for t in tokens]
+            top = int(payload.get("top_logprobs", 0))
+            if not 0 <= top <= 16:
+                raise ValueError(
+                    f"top_logprobs must be in [0, 16], got {top}")
+            model = payload.get("model")
+            if model is not None:
+                model = str(model)
+        except (KeyError, TypeError, ValueError) as e:
+            self._shed_log("bad_request", None, e)
+            self._reply_json(400, {"error": str(e)})
+            return 400
+        target = None
+        for r in self.server.scheduler.fleet():
+            if r.state != "healthy":
+                continue
+            if model is not None and \
+                    model not in getattr(r.engine, "_adapters", {}):
+                continue
+            if target is None or r.engine.load() < target.engine.load():
+                target = r
+        if target is None:
+            e = NoHealthyReplicaError(
+                f"no healthy replica holds "
+                f"model {model!r}" if model is not None
+                else "no healthy replica")
+            self._shed_log("shed", None, e)
+            self._reply_json(503, {"error": str(e)},
+                             extra_headers=self._budget_headers())
+            return 503
+        try:
+            entries = target.engine.score_tokens(tokens, model=model,
+                                                 top=top)
+        except (KeyError, ValueError) as e:
+            self._shed_log("bad_request", None, e)
+            self._reply_json(400, {"error": str(e)})
+            return 400
+        except Exception as e:
+            self._shed_log("error", None, e)
+            self._reply_json(500, {"error": str(e)})
+            return 500
+        body = {"tokens": tokens, "logprobs": entries,
+                "replica": target.replica_id}
+        if model is not None:
+            body["model"] = model
         self._reply_json(200, body)
         return 200
 

@@ -389,6 +389,23 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
         assert 0 <= route["affinity_hit_rate"] <= 1
         assert route["hedges"] >= 1        # the hedge arm really raced
         assert route["hedge_win"] is True  # hedged p99 <= unhedged p99
+        # ISSUE 19: the stream arm — SSE streaming of the same prompts
+        # is bit-exact vs buffered, the client-perceived first token
+        # beats the buffered full-response wait, a mid-stream hangup
+        # frees every KV block, and grammar-constrained sampled
+        # completions are 100% schema-valid.
+        stream = last["stream"]
+        for key in ("sessions", "outputs_match", "buffered_p50_ms",
+                    "ttft_p50_ms", "ttft_p99_ms", "intertoken_p99_ms",
+                    "ttft_win", "client_gone_kv_used",
+                    "client_gone_counted", "schema_valid",
+                    "schema_total", "schema_valid_rate"):
+            assert key in stream, f"stream.{key} missing: {stream}"
+        assert stream["outputs_match"] is True  # streamed ≡ buffered
+        assert stream["ttft_win"] is True       # first token ≪ full wait
+        assert stream["client_gone_kv_used"] == 0  # hangup freed blocks
+        assert stream["client_gone_counted"] >= 1
+        assert stream["schema_valid_rate"] == 1.0
         with open(path) as f:  # persisted under the serve+smoke keying
             assert json.load(f)["metric"] == "serve_tokens_per_sec"
     finally:
