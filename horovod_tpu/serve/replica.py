@@ -107,10 +107,14 @@ class Replica:
             # hvdshard go/no-go (ISSUE 17): the static replica-plan
             # verdict (pool budget x comm budget) rides the same
             # surface, so healthz shows plan_go per replica.
+            # hvdseqserve (serve/seqpar.py): the SP prefill world's
+            # geometry + counters ride the same surface — a multi-rank
+            # replica's healthz shows its ring comm budget and job
+            # history next to plan_go.
             for extra in ("pool_bytes", "weight_bytes",
                           "kv_headroom_bytes", "seq_forks",
                           "forked_requests", "spec_k",
-                          "plan_go", "plan_findings"):
+                          "plan_go", "plan_findings", "sp"):
                 if extra in kv:
                     out["kv_blocks"][extra] = kv[extra]
         return out

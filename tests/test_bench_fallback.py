@@ -72,6 +72,8 @@ def _bench_env(tag, **overrides):
                 "HVD_SERVE_TIER_OVERSUB", "HVD_SERVE_TIER_QUANTUM",
                 "HVD_SERVE_TIER_FETCH_TIMEOUT_S",
                 "HVD_SERVE_TIER_PUBLISH",
+                "HVD_SERVE_SP", "HVD_SERVE_SP_MIN_TOKENS",
+                "BENCH_SERVE_SP_RANKS",
                 "HVD_SERVE_DRAIN_S", "HVD_ROUTE_AFFINITY_BLOCKS",
                 "HVD_ROUTE_VNODES", "HVD_ROUTE_BOUNDED_LOAD",
                 "HVD_ROUTE_HEDGE_MS", "HVD_ROUTE_RETRY_MAX",
@@ -233,6 +235,29 @@ def test_serve_bench_smoke_emits_throughput_and_latency(tmp_path):
                     "unchunked_token_step_p99_ms"):
             assert key in chunked, f"chunked.{key} missing: {chunked}"
         assert chunked["outputs_match"] is True
+        # ISSUE 20: the SP variant of the interference storm keeps the
+        # chunked-prefill contract — SP prefill never worsens decode
+        # tail vs the unchunked baseline — and stays bit-exact.
+        for key in ("sp_token_step_p99_ms", "sp_p99_bounded",
+                    "sp_outputs_match"):
+            assert key in chunked, f"chunked.{key} missing: {chunked}"
+        assert chunked["sp_outputs_match"] is True
+        assert chunked["sp_p99_bounded"] is True
+        # ISSUE 20: the sequence-parallel prefill arm — emulated
+        # multi-rank long-prompt prefill with token-exact outputs, the
+        # emulation-model speedup, and the handoff/ring accounting.
+        sp = last["sp_prefill"]
+        for key in ("ranks", "emulated", "jobs", "speedup",
+                    "baseline_prefill_p50_ms", "sp_prefill_wall_p50_ms",
+                    "baseline_ttft_p50_ms", "ttft_p50_ms",
+                    "handoff_bytes", "ring_hops",
+                    "ring_bytes_per_prefill", "outputs_match"):
+            assert key in sp, f"sp_prefill.{key} missing: {sp}"
+        assert sp["outputs_match"] is True  # SP ≡ single-rank, exact
+        assert sp["emulated"] is True       # CPU-hermetic emulation
+        assert sp["jobs"] >= 1              # the SP path really engaged
+        assert sp["handoff_bytes"] > 0
+        assert sp["ring_hops"] > 0
         prefix = last["prefix"]
         for key in ("enabled", "hit_rate", "hit_tokens", "cow_copies"):
             assert key in prefix, f"prefix.{key} missing: {prefix}"

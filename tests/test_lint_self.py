@@ -52,11 +52,13 @@ def test_lint_covers_the_whole_tree():
     # and the hot-swap walk — same deal.
     # router.py / router_server.py (ISSUE 18) carry the front-door
     # retry/hedge/health machinery — same deal.
+    # seqpar.py (ISSUE 20) carries the sequence-parallel prefill world
+    # — the rank-block/handoff machinery must stay under the same lint.
     for mod in ("engine.py", "batcher.py", "blocks.py", "replica.py",
                 "server.py", "metrics.py", "paged_attention.py",
                 "sampling.py", "controller.py", "tenancy.py",
                 "registry.py", "tiering.py", "router.py",
-                "router_server.py"):
+                "router_server.py", "seqpar.py"):
         assert any(f.endswith(os.path.join("serve", mod))
                    for f in serve_files), f"serve/{mod} not linted"
     # Same for faultline/ (ISSUE 6): the injection layer must stay under

@@ -53,6 +53,7 @@ def test_mem_walk_covers_the_donating_tree():
                 os.path.join("serve", "tenancy.py"),
                 os.path.join("serve", "registry.py"),
                 os.path.join("serve", "tiering.py"),
+                os.path.join("serve", "seqpar.py"),
                 os.path.join("parallel", "__init__.py"),
                 os.path.join("analysis", "memplan.py"),
                 os.path.join("analysis", "shardplan.py")):

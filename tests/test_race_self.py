@@ -86,6 +86,11 @@ def test_race_walk_covers_the_threaded_tree():
                for f in files), "serve/tenancy.py not analyzed"
     assert any(f.endswith(os.path.join("serve", "tiering.py"))
                for f in files), "serve/tiering.py not analyzed"
+    # The SP world (ISSUE 20) is lock-FREE by design — every mutation
+    # happens on the engine loop thread; that property only holds if
+    # the race walker actually visits it.
+    assert any(f.endswith(os.path.join("serve", "seqpar.py"))
+               for f in files), "serve/seqpar.py not analyzed"
     # The hvdroute front door (ISSUE 18) runs forwards, hedges, and the
     # active health poller on their own threads over the router lock.
     for mod in ("router.py", "router_server.py"):

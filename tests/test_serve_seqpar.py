@@ -1,0 +1,384 @@
+"""ISSUE 20: hvdseqserve — sequence-parallel long-prompt prefill.
+
+Pins the tentpole's contracts layer by layer:
+
+* parallel/ring.py — ``ragged_fold`` (traced-offset online-softmax fold,
+  the shared math under both ring_attention's hops and the serving
+  engine's SP extents) matches a dense reference at ragged offsets
+  across every mask mode;
+* engine — SP prefill is TOKEN-IDENTICAL to the proven single-rank
+  chunked path at block-boundary prompt lengths (k*BT, k*BT±1) and at
+  both KV storage dtypes (native f32 and int8 — the handoff ships scale
+  rows bit-exactly through the tier transport);
+* faultline — the kill-rank drill: a rank dying mid-SP-prefill aborts
+  the job with ZERO block leaks on every rank, and the whole request
+  resubmits and completes (single-rank — requeued requests are
+  SP-ineligible, so the retry always makes progress);
+* compile stability — steady-state SP traffic never recompiles (pow2
+  extent buckets; decode programs untouched), and the warmup lattice
+  (HVD_SERVE_WARMUP) makes first-long-prompt *and* revived-replica
+  traffic land entirely on warm programs;
+* plan — ``check_replica_plan`` attributes the ring's per-prefill wire
+  bytes: plan_go flips under a tiny HVD_COMM_BUDGET_BYTES while the
+  decode path stays zero-collective;
+* admission — the batcher's advisory third resource: long prompts past
+  the world's transient-block capacity are still admitted, marked
+  ``sp_denied`` (they prefill single-rank);
+* hvdtrace — per-extent SP spans + handoff land under the request's
+  prefill stage, and the ring layer's RING_HOP schedule reaches the
+  engine-wired timeline.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faultline as fl
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.obs import tracing as tr
+from horovod_tpu.parallel import ring
+from horovod_tpu.serve import (BlockManager, DynamicBatcher,
+                               InferenceEngine, Request,
+                               TransformerAdapter)
+from horovod_tpu.serve.batcher import sp_extent_tokens
+from horovod_tpu.serve.seqpar import SPConfig, SPWorld
+
+BT = 8
+
+_TINY = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                          d_model=32, d_ff=64, max_len=64, causal=True,
+                          dtype=jnp.float32, scan_layers=False)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = Transformer(_TINY)
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+# Shared per-dtype adapters: the prefill/decode/SP compile caches live
+# on the adapter, so every engine in this module reuses them (the bench
+# discipline) instead of recompiling per test.
+@pytest.fixture(scope="module")
+def adapters(tiny_params):
+    return {kvd: TransformerAdapter(_TINY, tiny_params, block_tokens=BT,
+                                    kv_dtype=kvd)
+            for kvd in ("native", "int8")}
+
+
+def _prompt(n, seed=3):
+    return np.random.RandomState(seed).randint(0, 61, (n,)).tolist()
+
+
+def _run_one(adapter, prompt, *, sp_ranks=0, max_new=6, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("prefill_chunk", 5)  # deliberately unaligned with BT
+    kw.setdefault("prefix_cache", False)
+    if sp_ranks:
+        kw.setdefault("sp_min_tokens", 16)
+        kw["sp_ranks"] = sp_ranks
+    eng = InferenceEngine(adapter, kv_mode="paged",
+                          replica_id=f"sp-t{sp_ranks}", **kw).start()
+    try:
+        r = Request(list(prompt), max_new_tokens=max_new)
+        eng.batcher.submit(r)
+        out = r.result(timeout=120)
+        return out, r, eng.kv_stats(), eng
+    finally:
+        eng.stop()
+
+
+# -- ragged fold vs dense reference ------------------------------------------
+
+@pytest.mark.parametrize("mask_mode", [0, 1, 2])
+def test_ragged_fold_matches_dense_reference(mask_mode):
+    """Folding a sequence in ragged extents at traced global offsets
+    must equal one dense softmax over the concatenation — the identity
+    both ring_attention and the SP prefill engine stand on."""
+    rng = np.random.RandomState(0)
+    B, H, D, scale = 1, 2, 8, 0.25
+    Sq, q_start = 5, 7
+    q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.float32)
+    # Three extents with ragged true lengths inside pow2 buckets.
+    extents = [(0, 8, 7), (8, 8, 5), (13, 4, 3)]  # (k_start, bucket, len)
+    ks, vs = {}, {}
+    for st, bucket, ln in extents:
+        ks[st] = jnp.asarray(rng.randn(B, bucket, H, D), jnp.float32)
+        vs[st] = jnp.asarray(rng.randn(B, bucket, H, D), jnp.float32)
+    acc, m, l = ring.ragged_fold_init(q)
+    for st, bucket, ln in extents:
+        acc, m, l = ring.ragged_fold(
+            q, ks[st], vs[st], q_start=jnp.int32(q_start),
+            k_start=jnp.int32(st), k_len=jnp.int32(ln),
+            acc=acc, m=m, l=l, scale=scale, mask_mode=mask_mode)
+    got = np.asarray(ring.ragged_fold_finish(acc, m, l))
+
+    k_all = np.concatenate([np.asarray(ks[st][:, :ln])
+                            for st, _, ln in extents], axis=1)
+    v_all = np.concatenate([np.asarray(vs[st][:, :ln])
+                            for st, _, ln in extents], axis=1)
+    s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), k_all) * scale
+    iq = q_start + np.arange(Sq)[:, None]
+    # GLOBAL key positions — extent (13, 4, 3) starts past extent
+    # (8, 8, 5)'s true end, so column index != position.
+    ik = np.concatenate([st + np.arange(ln)
+                         for st, _, ln in extents])[None, :]
+    if mask_mode == 1:
+        s = np.where(iq >= ik, s, -np.inf)
+    elif mask_mode == 2:
+        s = np.where(iq > ik, s, -np.inf)
+    p = np.exp(s - s.max(axis=-1, keepdims=True))
+    p /= p.sum(axis=-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, v_all)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_sp_extent_tokens_geometry():
+    assert sp_extent_tokens(40, 4, 8) == 16   # ceil(40/4)=10 → block up
+    assert sp_extent_tokens(64, 4, 8) == 16
+    assert sp_extent_tokens(33, 4, 16) == 16  # trailing extents empty
+    with pytest.raises(ValueError):
+        sp_extent_tokens(8, 0, 8)
+
+
+def test_sp_config_env(monkeypatch):
+    monkeypatch.setenv("HVD_SERVE_SP", "4")
+    monkeypatch.setenv("HVD_SERVE_SP_MIN_TOKENS", "99")
+    cfg = SPConfig()
+    assert cfg.enabled and cfg.ranks == 4 and cfg.min_tokens == 99
+    monkeypatch.setenv("HVD_SERVE_SP", "0")
+    assert not SPConfig().enabled
+    with pytest.raises(ValueError):
+        SPWorld(object(), 1, 16)
+
+
+# -- engine bit-exactness -----------------------------------------------------
+
+@pytest.mark.parametrize("plen", [3 * BT, 3 * BT - 1, 3 * BT + 1])
+def test_sp_matches_single_rank_at_block_boundaries(adapters, plen):
+    prompt = _prompt(plen)
+    base, _, _, _ = _run_one(adapters["native"], prompt)
+    got, _, stats, _ = _run_one(adapters["native"], prompt, sp_ranks=4)
+    assert got == base
+    assert stats["sp"]["jobs"] == 1 and stats["sp"]["aborts"] == 0
+    assert stats["sp"]["sp_tokens"] == plen
+
+
+def test_sp_matches_single_rank_int8(adapters):
+    """int8 KV blocks: the extent handoff ships quantized payloads WITH
+    their scale rows through pack_payload/unpack_payload — decode over
+    handed-off blocks equals decode over locally-prefilled ones."""
+    prompt = _prompt(5 * BT - 3, seed=11)
+    base, _, _, _ = _run_one(adapters["int8"], prompt)
+    got, _, stats, _ = _run_one(adapters["int8"], prompt, sp_ranks=4)
+    assert got == base
+    assert stats["sp"]["jobs"] == 1
+    assert stats["sp"]["handoff_bytes"] > 0
+    assert stats["sp"]["ring_hops"] == 3  # sum of causal folds, 4 ranks
+
+
+# -- faultline: kill-rank mid-SP-prefill --------------------------------------
+
+def test_kill_rank_mid_sp_prefill_resubmits_whole_no_leaks(adapters):
+    prompt = _prompt(40, seed=7)
+    base, _, _, _ = _run_one(adapters["native"], prompt)
+    fl.install(fl.FaultPlan(
+        [fl.FaultSpec("kill-rank", point="sp.prefill", step=0)]))
+    try:
+        got, r, stats, eng = _run_one(adapters["native"], prompt,
+                                      sp_ranks=4)
+    finally:
+        fl.uninstall()
+    assert got == base                 # faults cost latency, not answers
+    assert r.requeues == 1             # resubmitted whole...
+    assert stats["sp"]["jobs"] == 1
+    assert stats["sp"]["aborts"] == 1  # ...after the world aborted
+    # Zero leaks on EVERY rank: each side manager is fully free again.
+    for m in eng.seqpar.managers:
+        assert m.available() == eng.seqpar.blocks_per_rank
+        assert m.stats()["used"] == 0
+    # ... and the retry went single-rank (requeued → SP-ineligible), so
+    # no second job was ever claimed.
+    assert eng.metrics.snapshot()["sp"]["prefills"] == 0
+
+
+# -- compile stability --------------------------------------------------------
+
+def test_sp_steady_state_never_recompiles(adapters, tiny_params):
+    """Second same-bucket long prompt: zero new SP chunk programs, and
+    the decode program set is untouched by SP entirely."""
+    ad = TransformerAdapter(_TINY, tiny_params, block_tokens=BT)
+    prompt = _prompt(40, seed=5)
+    eng = InferenceEngine(ad, kv_mode="paged", replica_id="sp-steady",
+                          max_batch=8, prefill_chunk=5,
+                          prefix_cache=False, sp_ranks=4,
+                          sp_min_tokens=16).start()
+    try:
+        r1 = Request(list(prompt), max_new_tokens=4)
+        eng.batcher.submit(r1)
+        r1.result(timeout=120)
+        sp_keys = set(ad._sp_chunk_cache)
+        decode_keys = set(ad._paged_decode_fns)
+        assert sp_keys  # the SP path really compiled something
+        r2 = Request(list(_prompt(40, seed=6)), max_new_tokens=4)
+        eng.batcher.submit(r2)
+        r2.result(timeout=120)
+        assert set(ad._sp_chunk_cache) == sp_keys        # the pin
+        assert set(ad._paged_decode_fns) == decode_keys  # decode intact
+        assert eng.kv_stats()["sp"]["jobs"] == 2
+    finally:
+        eng.stop()
+
+
+def test_sp_warmup_lattice_and_revival(adapters, tiny_params):
+    """HVD_SERVE_WARMUP covers the SP bucket lattice: real long-prompt
+    traffic after warmup adds ZERO programs, and a revived engine
+    (stop → start, the mark_alive path — PR 13 pin) re-runs warmup with
+    the lattice already cached."""
+    ad = TransformerAdapter(_TINY, tiny_params, block_tokens=BT)
+    eng = InferenceEngine(ad, kv_mode="paged", replica_id="sp-warm",
+                          max_batch=8, prefill_chunk=5,
+                          prefix_cache=False, sp_ranks=4,
+                          sp_min_tokens=16, warmup=True).start()
+    try:
+        assert eng.warmup_runs == 1
+        warm_keys = set(ad._sp_chunk_cache)
+        assert warm_keys  # the lattice compiled SP programs
+        r = Request(list(_prompt(40, seed=9)), max_new_tokens=4)
+        eng.batcher.submit(r)
+        r.result(timeout=120)
+        assert eng.kv_stats()["sp"]["jobs"] == 1
+        assert set(ad._sp_chunk_cache) == warm_keys  # zero new compiles
+        eng.stop()
+        eng.start()                    # revival re-runs warmup (PR 13)
+        assert eng.warmup_runs == 2
+        assert set(ad._sp_chunk_cache) == warm_keys
+    finally:
+        eng.stop()
+
+
+# -- plan census --------------------------------------------------------------
+
+def test_sp_plan_attributes_ring_bytes(adapters, monkeypatch):
+    eng = InferenceEngine(adapters["native"], kv_mode="paged",
+                          replica_id="sp-plan", max_batch=8,
+                          prefill_chunk=5, prefix_cache=False,
+                          sp_ranks=4, sp_min_tokens=16)
+    stats = eng.kv_stats()
+    assert stats["sp"]["ring_bytes_per_prefill"] > 0
+    assert eng.sp_comm_bytes == stats["sp"]["ring_bytes_per_prefill"]
+    assert stats["plan_go"] is True
+    # A single-rank engine attributes zero SP wire bytes (the decode
+    # plane stays zero-collective — the ROADMAP-5 serving invariant).
+    single = InferenceEngine(adapters["native"], kv_mode="paged",
+                             replica_id="sp-plan0", max_batch=8,
+                             prefill_chunk=5, prefix_cache=False)
+    assert single.sp_comm_bytes == 0
+    assert "sp" not in single.kv_stats()
+    # A comm budget smaller than one prefill's rotation: no-go, surfaced
+    # on healthz via kv_stats (plan_go — the hvdshard HVD401 check).
+    monkeypatch.setenv("HVD_COMM_BUDGET_BYTES", "1")
+    tight = InferenceEngine(adapters["native"], kv_mode="paged",
+                            replica_id="sp-tight", max_batch=8,
+                            prefill_chunk=5, prefix_cache=False,
+                            sp_ranks=4, sp_min_tokens=16)
+    assert tight.kv_stats()["plan_go"] is False
+
+
+# -- admission ----------------------------------------------------------------
+
+def test_sp_denied_is_advisory_not_rejection():
+    """The third admission resource (transient extent blocks) never
+    rejects: an over-capacity long prompt is admitted with sp_denied
+    set, and short prompts are never charged."""
+    b = DynamicBatcher(max_wait_ms=0.0)
+    long1 = Request(list(range(40)), max_new_tokens=2)
+    long2 = Request(list(range(40, 80)), max_new_tokens=2)
+    short = Request([1, 2, 3], max_new_tokens=2)
+    for r in (long1, long2, short):
+        b.submit(r)
+    got = b.get_admission(8, sp_min_tokens=16, sp_capacity=2,
+                          sp_cost=lambda r: 2)
+    assert got == [long1, long2, short]       # all admitted
+    assert long1.sp_denied is False           # fit the capacity...
+    assert long2.sp_denied is True            # ...which long1 drained
+    assert short.sp_denied is False           # never charged
+
+
+def test_sp_world_single_job_capacity(adapters):
+    world = SPWorld(adapters["native"], 4, 16)
+    assert world.free_extent_blocks() == world.blocks_per_rank
+    assert world.extent_cost_blocks(40) == 2  # 16-token extent, BT=8
+    assert world.ring_bytes_per_prefill() == 4 * 3 * world._hop_bytes()
+
+
+# -- hvdtrace -----------------------------------------------------------------
+
+class _HopTimeline:
+    def __init__(self):
+        self.hops = []
+
+    def ring_hop(self, name, hop, **kw):
+        self.hops.append((name, hop, kw))
+
+    def trace_span(self, *a, **k):
+        pass
+
+
+def test_sp_spans_and_ring_hops_reach_the_tracer(adapters):
+    """A traced request's SP prefill emits per-extent chunk + handoff
+    spans under the request's trace, and the engine wires the ring
+    layer's RING_HOP schedule at the tracer's timeline."""
+    tracer = tr.install(tr.Tracer(sample=1.0))
+    tl = _HopTimeline()
+    tracer.set_timeline(tl)
+    # 56 tokens / 4 ranks → 16-token block-rounded extents 16/16/16/8:
+    # every rank owns a LIVE extent (40 would leave rank 3 empty).
+    prompt = _prompt(56, seed=13)
+    eng = InferenceEngine(adapters["native"], kv_mode="paged",
+                          replica_id="sp-trace", max_batch=8,
+                          prefill_chunk=5, prefix_cache=False,
+                          sp_ranks=4, sp_min_tokens=16).start()
+    try:
+        r = Request(list(prompt), max_new_tokens=4)
+        r.trace = tracer.new_context()
+        eng.batcher.submit(r)
+        r.result(timeout=120)
+        assert eng.kv_stats()["sp"]["jobs"] == 1
+        traces = tracer.recent_traces()
+        spans = [s for t in traces if t["trace_id"] == r.trace.trace_id
+                 for s in t["tree"]]
+        names = [s["name"] for s in spans]
+        assert "sp-extent-chunk" in names
+        assert "sp-handoff" in names
+        chunk_args = [s["args"] for s in spans
+                      if s["name"] == "sp-extent-chunk"]
+        assert {a["rank"] for a in chunk_args} == {0, 1, 2, 3}
+        hand_args = [s["args"] for s in spans if s["name"] == "sp-handoff"]
+        assert sum(a["bytes"] for a in hand_args) == \
+            eng.kv_stats()["sp"]["handoff_bytes"]
+        assert any(a["bytes"] == 0 for a in hand_args)  # rank-0 is local
+        # RING_HOP schedule: n hops under the serve-qualified tensor
+        # name, with the causal skip accounting.
+        sp_hops = [h for h in tl.hops if "sp_prefill" in h[0]]
+        assert len(sp_hops) == 4
+        assert sp_hops[0][0].startswith("serve:sp-trace:sp/")
+        assert {h[1] for h in sp_hops} == {0, 1, 2, 3}
+        assert all(h[2]["bytes_rotated"] > 0 for h in sp_hops)
+    finally:
+        eng.stop()
+        tr.uninstall()
+
+
+def test_sp_prefill_stage_partitions_exactly(adapters):
+    """stage_ms must still partition the request's wall: SP prefill
+    accounts into the prefill stage (no new stage label)."""
+    _, r, _, _ = _run_one(adapters["native"], _prompt(40, seed=17),
+                          sp_ranks=4)
+    assert set(r.stage_ms) >= {"queue", "prefill", "decode"}
+    assert r.stage_ms["prefill"] > 0.0
+    total = sum(r.stage_ms.values())
+    assert total > 0.0
